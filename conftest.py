@@ -1,0 +1,24 @@
+"""Root pytest conftest: force an 8-device virtual CPU mesh.
+
+Tests exercise real SPMD semantics (mesh sharding, psum/pmean collectives)
+without TPU hardware via ``--xla_force_host_platform_device_count=8`` —
+the JAX equivalent of the reference author's "single node, loopback master"
+trick (ref config.py:19-20).
+
+This must run before anything initializes a JAX backend: the environment's
+sitecustomize registers a TPU tunnel backend at interpreter startup, and
+``jax.config.update('jax_platforms', 'cpu')`` re-points selection at the
+host platform, while XLA_FLAGS (read at first backend init) fans it out to
+8 virtual devices.  Set DPT_TESTS_ON_TPU=1 to run the suite on real chips.
+"""
+
+import os
+
+if os.environ.get("DPT_TESTS_ON_TPU") != "1":
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

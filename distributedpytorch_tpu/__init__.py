@@ -1,0 +1,33 @@
+"""distributedpytorch_tpu — a TPU-native (JAX/XLA) re-design of
+georand/distributedpytorch.
+
+The reference (`/root/reference`, 962 lines of Python) is a multi-node,
+multi-GPU Distributed Data Parallel image-classification trainer built on
+torch.distributed/NCCL.  This package provides the same capability set —
+SPMD launch, collective-backed data-parallel training, sharded data loading,
+checkpoint/resume, a train/test CLI, a model zoo, a loss zoo, seeding and
+logging — re-architected idiomatically for TPU:
+
+  * topology comes from the JAX runtime (``jax.distributed.initialize`` +
+    ``jax.process_index``), not a hand-edited IP table
+    (ref: main.py:60-110);
+  * the DDP wrapper's hidden gradient allreduce (ref: classif.py:138)
+    becomes an explicit ``jax.lax.pmean`` over a named mesh axis inside a
+    jit-compiled SPMD train step;
+  * ``DistributedSampler`` (ref: dataloader.py:147-152) becomes a
+    deterministic, epoch-keyed global permutation sharded by process index;
+  * data augmentation runs *on device* as a single fused affine warp inside
+    jit — there is no host-side transform pipeline to bottleneck on.
+
+Layer map (mirrors SURVEY.md §1):
+
+  L0  config          distributedpytorch_tpu.config
+  L1  runtime/utils   distributedpytorch_tpu.runtime, .utils, .checkpoint
+  L2  data            distributedpytorch_tpu.data
+  L3  engine          distributedpytorch_tpu.train, .ops
+  L4  launcher/CLI    distributedpytorch_tpu.cli  (entry: main.py)
+  --  models          distributedpytorch_tpu.models
+  --  parallelism     distributedpytorch_tpu.parallel
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,17 @@
+"""L2: data pipeline (TPU-native replacement for ref dataloader.py).
+
+The reference pipeline is: torchvision dataset -> per-sample host transforms
+in NUM_WORKERS loader processes -> DistributedSampler shard -> pinned-memory
+H2D copy (ref dataloader.py:89-170).  On TPU (and with augmentation fused
+into the jitted step) the pipeline collapses to:
+
+  raw uint8 arrays on host  ->  epoch-keyed global permutation (sampler.py)
+  ->  contiguous gather of this process's shard  ->  sharded device_put
+  ->  on-device augment/normalize inside the compiled step (augment.py).
+"""
+
+from .datasets import Dataset, load_dataset
+from .sampler import ShardedSampler
+from .pipeline import ShardedLoader
+
+__all__ = ["Dataset", "load_dataset", "ShardedSampler", "ShardedLoader"]

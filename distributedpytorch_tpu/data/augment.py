@@ -1,0 +1,129 @@
+"""On-device augmentation: the reference's transform pipeline as one fused
+batched affine warp, jit-compiled onto the TPU.
+
+Reference pipeline (ref dataloader.py:101-116), executed per-sample on host
+CPU in NUM_WORKERS loader processes:
+
+  train: RandomRotation(5, fill=0) -> RandomResizedCrop(dataDim)
+         -> ToTensor -> TensorRepeat(3) -> Normalize(mean, std)
+  eval:  Resize(dataDim) -> CenterCrop(dataDim)
+         -> ToTensor -> TensorRepeat(3) -> Normalize(mean, std)
+
+TPU-native redesign: rotation and random-resized-crop are both affine maps,
+so they compose into a *single* inverse-affine bilinear sample per image —
+one pass over the pixels, batched with vmap, running on device inside the
+same XLA program as the forward/backward step.  ToTensor/repeat/normalize
+fuse into the same kernel for free.  This removes the host-side transform
+bottleneck entirely (the image never exists at dataDim resolution on host).
+
+Parity notes vs torchvision:
+  * RandomResizedCrop samples scale∈(0.08,1.0), log-uniform ratio∈(3/4,4/3)
+    like torchvision, but clamps the crop box into bounds instead of the
+    10-attempt rejection loop + center-crop fallback (rejection is
+    jit-hostile; the sampled distributions differ only in rare tail cases).
+  * Rotation angle ~ U(-5°,5°), fill 0, about the image center — same.
+  * All randomness flows from a single JAX key: per-image keys are derived
+    with fold_in, so results are independent of batch size and device count.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.ndimage import map_coordinates
+
+SCALE_RANGE = (0.08, 1.0)        # torchvision RandomResizedCrop defaults
+LOG_RATIO_RANGE = (jnp.log(3.0 / 4.0), jnp.log(4.0 / 3.0))
+MAX_ROTATION_DEG = 5.0           # ref dataloader.py:102
+
+
+def _sample_affine(key: jax.Array, h: int, w: int
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array,
+                              jax.Array]:
+    """Sample (theta, crop_y0, crop_x0, crop_h, crop_w) for one image."""
+    k_rot, k_scale, k_ratio, k_y, k_x = jax.random.split(key, 5)
+    theta = jax.random.uniform(
+        k_rot, minval=-MAX_ROTATION_DEG, maxval=MAX_ROTATION_DEG
+    ) * (jnp.pi / 180.0)
+    scale = jax.random.uniform(
+        k_scale, minval=SCALE_RANGE[0], maxval=SCALE_RANGE[1])
+    ratio = jnp.exp(jax.random.uniform(
+        k_ratio, minval=LOG_RATIO_RANGE[0], maxval=LOG_RATIO_RANGE[1]))
+    area = scale * h * w
+    crop_w = jnp.clip(jnp.sqrt(area * ratio), 1.0, float(w))
+    crop_h = jnp.clip(jnp.sqrt(area / ratio), 1.0, float(h))
+    y0 = jax.random.uniform(k_y) * (h - crop_h)
+    x0 = jax.random.uniform(k_x) * (w - crop_w)
+    return theta, y0, x0, crop_h, crop_w
+
+
+def _warp_one(img: jax.Array, key: jax.Array, out_dim: int) -> jax.Array:
+    """Inverse-affine bilinear sample of one (H,W) image -> (out,out).
+
+    Output pixel (i,j) -> crop-box coords in the rotated frame -> rotate by
+    -theta about the image center -> source coords in the original image.
+    Outside-of-image samples read 0 (RandomRotation's fill, ref :102).
+    """
+    h, w = img.shape
+    theta, y0, x0, crop_h, crop_w = _sample_affine(key, h, w)
+
+    ii = jnp.arange(out_dim, dtype=jnp.float32)
+    # Half-pixel-centered resize convention (matches bilinear resize).
+    ys = y0 + (ii[:, None] + 0.5) * crop_h / out_dim - 0.5
+    xs = x0 + (ii[None, :] + 0.5) * crop_w / out_dim - 0.5
+    ys = jnp.broadcast_to(ys, (out_dim, out_dim))
+    xs = jnp.broadcast_to(xs, (out_dim, out_dim))
+
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    cos_t, sin_t = jnp.cos(-theta), jnp.sin(-theta)
+    src_y = cos_t * (ys - cy) - sin_t * (xs - cx) + cy
+    src_x = sin_t * (ys - cy) + cos_t * (xs - cx) + cx
+
+    return map_coordinates(img, [src_y, src_x], order=1, mode="constant",
+                           cval=0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("out_dim", "out_dtype"))
+def train_transform(key: jax.Array, images: jax.Array, mean: jax.Array,
+                    std: jax.Array, out_dim: int,
+                    out_dtype=jnp.float32) -> jax.Array:
+    """uint8 (B,H,W) or (B,H,W,C) -> augmented float (B,out,out,3).
+
+    Fused: rotate + random-resized-crop (one bilinear pass) + gray->3ch
+    (ref TensorRepeat, dataloader.py:31-44) + normalize (ref :107).
+    """
+    b = images.shape[0]
+    grayscale = images.ndim == 3
+    imgs = images.astype(jnp.float32) / 255.0
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(b))
+
+    if grayscale:
+        out = jax.vmap(_warp_one, in_axes=(0, 0, None))(imgs, keys, out_dim)
+        out = out[..., None].repeat(3, axis=-1)
+    else:
+        # Same geometric params for all channels of an image.
+        warp_hw = jax.vmap(_warp_one, in_axes=(2, None, None), out_axes=2)
+        out = jax.vmap(warp_hw, in_axes=(0, 0, None))(imgs, keys, out_dim)
+    return ((out - mean) / std).astype(out_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("out_dim", "out_dtype"))
+def eval_transform(images: jax.Array, mean: jax.Array, std: jax.Array,
+                   out_dim: int, out_dtype=jnp.float32) -> jax.Array:
+    """uint8 batch -> float (B,out,out,3): resize+center-crop+normalize.
+
+    Ref eval pipeline dataloader.py:109-116.  Inputs are square, so
+    Resize(out)+CenterCrop(out) is exactly a bilinear resize to (out,out).
+    """
+    grayscale = images.ndim == 3
+    imgs = images.astype(jnp.float32) / 255.0
+    if grayscale:
+        imgs = imgs[..., None]
+    b, _, _, c = imgs.shape
+    out = jax.image.resize(imgs, (b, out_dim, out_dim, c), method="bilinear")
+    if grayscale:
+        out = out.repeat(3, axis=-1)
+    return ((out - mean) / std).astype(out_dtype)

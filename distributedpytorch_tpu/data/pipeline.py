@@ -1,0 +1,98 @@
+"""Batch iteration: host gather -> sharded device arrays, with prefetch.
+
+Replaces the reference's DataLoader stack (ref dataloader.py:153-170:
+NUM_WORKERS=2 worker processes, pin_memory=True, per-batch H2D copies at
+ref classif.py:43-44).  TPU-native shape of the same idea:
+
+  * the only host work per step is a numpy fancy-index gather of raw uint8
+    rows (augmentation happens on device — see augment.py), so no worker
+    processes are needed;
+  * batches are placed as *global* jax.Arrays sharded along the batch axis
+    over the 'data' mesh axis; on multi-host each process contributes the
+    rows for its own chips (jax.make_array_from_process_local_data);
+  * ``device_put`` is asynchronous, so a small lookahead queue (depth =
+    Config.prefetch, the NUM_WORKERS analogue) double-buffers the H2D copy
+    behind the previous step's compute — the pin_memory/non_blocking
+    equivalent.
+
+Each step yields (images u8, labels i32, valid bool) — ``valid`` masks the
+wraparound padding the sampler added to keep shapes static (see sampler.py).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterator, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .datasets import Split
+from .sampler import ShardedSampler
+from ..runtime import DATA_AXIS
+
+
+class ShardedLoader:
+    """Iterates one split as sharded global batches of shape (world*B, ...)."""
+
+    def __init__(self, split: Split, mesh: Mesh, batch_per_replica: int,
+                 shuffle: bool, seed: int, prefetch: int = 2):
+        self.split = split
+        self.mesh = mesh
+        self.batch_per_replica = batch_per_replica
+        self.prefetch = max(1, prefetch)
+        self.world = mesh.devices.size
+        self.sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+        # This process's slice of the global rank space.  Mesh device order
+        # is the global batch order; rows for device d sit at block d.
+        devs = list(mesh.devices.flat)
+        self.local_ranks = [i for i, d in enumerate(devs)
+                            if d.process_index == jax.process_index()]
+        self.samplers = [
+            ShardedSampler(num_samples=len(split), world_size=self.world,
+                           rank=r, batch_size=batch_per_replica,
+                           shuffle=shuffle, seed=seed)
+            for r in self.local_ranks
+        ]
+        self.batches_per_epoch = self.samplers[0].batches_per_epoch
+
+    def __len__(self) -> int:
+        return self.batches_per_epoch
+
+    @property
+    def global_batch(self) -> int:
+        return self.world * self.batch_per_replica
+
+    def _host_batches(self, epoch: int):
+        per_rank = [s.epoch_indices(epoch) for s in self.samplers]
+        imgs, labels = self.split.images, self.split.labels
+        for step in range(self.batches_per_epoch):
+            idx = np.concatenate([ix[step] for ix, _ in per_rank])
+            valid = np.concatenate([v[step] for _, v in per_rank])
+            yield imgs[idx], labels[idx], valid
+
+    def _to_device(self, arrays) -> Tuple[jax.Array, ...]:
+        if jax.process_count() == 1:
+            return tuple(jax.device_put(a, self.sharding) for a in arrays)
+        return tuple(
+            jax.make_array_from_process_local_data(self.sharding, a)
+            for a in arrays)
+
+    def epoch(self, epoch: int) -> Iterator[Tuple[jax.Array, jax.Array,
+                                                  jax.Array]]:
+        """Async-prefetched iterator over one epoch's sharded batches."""
+        queue = collections.deque()
+        host_iter = self._host_batches(epoch)
+        try:
+            while len(queue) < self.prefetch:
+                queue.append(self._to_device(next(host_iter)))
+        except StopIteration:
+            pass
+        while queue:
+            yield queue.popleft()
+            try:
+                queue.append(self._to_device(next(host_iter)))
+            except StopIteration:
+                pass

@@ -1,0 +1,103 @@
+"""L1: utilities — logging, timing, RNG discipline.
+
+Counterpart of the reference's utils.py grab-bag, minus what moved to
+dedicated modules (model zoo → models/, checkpoint → checkpoint.py,
+losses/metrics → ops/).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+from typing import Tuple
+
+import jax
+import numpy as np
+
+
+def initialize_logging(rsl_path: str, log_file: str,
+                       truncate: bool = True) -> None:
+    """File + stdout logging (ref: initializeLogging, utils.py:196-202).
+
+    The reference opens the file with mode 'w' in *every* process, so ranks
+    truncate each other's log.  Here only one process should call this with
+    ``truncate=True``; others append — combined with the global process-index
+    gate in runtime.is_main() this fixes SURVEY defect #7.
+    """
+    os.makedirs(rsl_path, exist_ok=True)
+    mode = "w" if truncate else "a"
+    root = logging.getLogger()
+    # Re-invocation safe (the reference re-inits in every driver,
+    # classif.py:79,201): clear stale handlers rather than stacking them.
+    for h in list(root.handlers):
+        root.removeHandler(h)
+        h.close()
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(message)s",
+        handlers=[
+            logging.FileHandler(os.path.join(rsl_path, log_file), mode=mode),
+            logging.StreamHandler(sys.stdout),
+        ],
+    )
+
+
+def get_duration(start_time: float, end_time: float) -> Tuple[int, int]:
+    """(minutes, seconds) split (ref: getDuration, utils.py:182-186)."""
+    elapsed = end_time - start_time
+    mins = int(elapsed / 60)
+    secs = int(elapsed - mins * 60)
+    return mins, secs
+
+
+def monotonic() -> float:
+    return time.monotonic()
+
+
+def root_key(seed: int) -> jax.Array:
+    """The run's root PRNG key (ref: setRandomSeed, utils.py:188-194).
+
+    The reference seeds four global generators with the same value on every
+    rank.  JAX's functional PRNG replaces all of that with one key; derive
+    per-purpose streams with ``fold_key`` so data order, augmentation and
+    init never collide.  XLA is deterministic by construction — there is no
+    cudnn.benchmark equivalent to switch off.
+    """
+    return jax.random.PRNGKey(seed)
+
+
+def fold_key(key: jax.Array, *ids: int) -> jax.Array:
+    """Derive a substream, e.g. fold_key(root, epoch, process_index)."""
+    for i in ids:
+        key = jax.random.fold_in(key, i)
+    return key
+
+
+def epoch_numpy_rng(seed: int, epoch: int) -> np.random.Generator:
+    """Host-side generator for the sampler permutation.
+
+    Seeded from (seed, epoch) exactly like DistributedSampler's
+    ``g.manual_seed(self.seed + self.epoch)`` (torch semantics the reference
+    relies on via ref dataloader.py:147 + classif.py:164-165) — identical on
+    every process so all ranks agree on the global permutation.
+    """
+    return np.random.default_rng(np.uint64(seed) + np.uint64(epoch))
+
+
+def tree_size_bytes(tree) -> int:
+    return sum(x.size * x.dtype.itemsize
+               for x in jax.tree_util.tree_leaves(tree))
+
+
+def print_network_info(params) -> None:
+    """Param inventory (ref: printNetworkInfo, utils.py:164-166 — fixed:
+    the reference passes multiple args to logging.info and crashes)."""
+    leaves = jax.tree_util.tree_leaves_with_path(params)
+    total = 0
+    for path, leaf in leaves:
+        total += leaf.size
+        logging.info(f"{jax.tree_util.keystr(path)}: "
+                     f"{tuple(leaf.shape)} {leaf.dtype}")
+    logging.info(f"total parameters: {total:,}")

@@ -1,0 +1,110 @@
+"""Dataset loading, splits, stats, augmentation, sharded loader."""
+
+import gzip
+import os
+import struct
+
+import jax
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu import runtime
+from distributedpytorch_tpu.data import augment, datasets, io, pipeline
+
+
+def test_devices_are_virtual_cpu_mesh():
+    assert jax.devices()[0].platform == "cpu"
+    assert jax.device_count() == 8
+
+
+def test_synthetic_dataset_shapes_and_stats():
+    ds = datasets.load_dataset("synthetic", "/tmp/none", seed=1234)
+    assert len(ds.splits["train"]) == 54000      # 90% of 60000
+    assert len(ds.splits["valid"]) == 6000
+    assert len(ds.splits["test"]) == 10000
+    assert ds.splits["train"].images.dtype == np.uint8
+    assert 0.0 < ds.mean < 1.0 and 0.0 < ds.std < 1.0
+    assert ds.nb_classes == 10
+    w = ds.class_weights()
+    assert w.shape == (10,) and np.all(w > 0)
+
+
+def test_debug_subset_is_200(tmp_path):
+    ds = datasets.load_dataset("synthetic", str(tmp_path), seed=1234,
+                               debug=True)
+    assert len(ds.splits["train"]) == 200       # ref dataloader.py:141
+
+
+def test_idx_roundtrip(tmp_path):
+    """Write the MNIST wire format (gzipped) and read it back."""
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, size=(7, 28, 28), dtype=np.uint8)
+    labels = rng.integers(0, 10, size=(7,), dtype=np.uint8)
+    raw = tmp_path / "MNIST" / "raw"
+    os.makedirs(raw)
+
+    def _write(name, arr):
+        header = struct.pack(">HBB", 0, 0x08, arr.ndim)
+        header += struct.pack(">" + "I" * arr.ndim, *arr.shape)
+        with gzip.open(raw / (name + ".gz"), "wb") as f:
+            f.write(header + arr.tobytes())
+
+    _write("train-images-idx3-ubyte", imgs)
+    _write("train-labels-idx1-ubyte", labels)
+    _write("t10k-images-idx3-ubyte", imgs)
+    _write("t10k-labels-idx1-ubyte", labels)
+
+    tr_x, tr_y, te_x, te_y = io.load_mnist_like(str(tmp_path), "MNIST")
+    np.testing.assert_array_equal(tr_x, imgs)
+    np.testing.assert_array_equal(tr_y, labels)
+    np.testing.assert_array_equal(te_x, imgs)
+
+
+def test_train_transform_shapes_channels_determinism():
+    imgs = np.random.default_rng(0).integers(
+        0, 256, size=(8, 28, 28), dtype=np.uint8)
+    key = jax.random.PRNGKey(42)
+    out = augment.train_transform(key, imgs, 0.5, 0.25, 28)
+    assert out.shape == (8, 28, 28, 3)
+    # grayscale -> 3 identical channels (ref TensorRepeat)
+    np.testing.assert_allclose(out[..., 0], out[..., 1])
+    # same key -> identical; different key -> different
+    out2 = augment.train_transform(key, imgs, 0.5, 0.25, 28)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2))
+    out3 = augment.train_transform(jax.random.PRNGKey(43), imgs, 0.5, 0.25, 28)
+    assert not np.allclose(np.asarray(out), np.asarray(out3))
+
+
+def test_train_transform_rgb():
+    imgs = np.random.default_rng(0).integers(
+        0, 256, size=(4, 32, 32, 3), dtype=np.uint8)
+    out = augment.train_transform(jax.random.PRNGKey(0), imgs, 0.5, 0.25, 32)
+    assert out.shape == (4, 32, 32, 3)
+
+
+def test_eval_transform_is_deterministic_resize_normalize():
+    imgs = np.full((2, 28, 28), 128, dtype=np.uint8)
+    out = augment.eval_transform(imgs, 0.5, 0.25, 56)
+    assert out.shape == (2, 56, 56, 3)
+    # constant image: resize exact, normalize = (128/255 - .5)/.25
+    expected = np.full_like(np.asarray(out), (128 / 255 - 0.5) / 0.25)
+    np.testing.assert_allclose(np.asarray(out), expected, atol=1e-4)
+
+
+def test_sharded_loader_batches():
+    ds = datasets.load_dataset("synthetic", "/tmp/none", seed=1234)
+    mesh = runtime.make_mesh()
+    ld = pipeline.ShardedLoader(ds.splits["valid"], mesh, 16,
+                                shuffle=True, seed=1234)
+    assert ld.global_batch == 16 * 8
+    steps = 0
+    for imgs, labels, valid in ld.epoch(0):
+        assert imgs.shape == (128, 28, 28)
+        assert labels.shape == (128,)
+        assert imgs.sharding.spec == jax.sharding.PartitionSpec("data")
+        assert len(imgs.addressable_shards) == 8
+        steps += 1
+    assert steps == len(ld)
+    # epoch coverage: all valid labels across ranks match dataset exactly
+    total_valid = sum(int(np.asarray(v).sum()) for _, _, v in ld.epoch(1))
+    assert total_valid == len(ds.splits["valid"])

@@ -1,0 +1,55 @@
+"""DistributedSampler-parity semantics (ref dataloader.py:147-152)."""
+
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.data.sampler import ShardedSampler
+
+
+def _all_ranks(n, world, batch, shuffle=True, seed=1234):
+    return [ShardedSampler(num_samples=n, world_size=world, rank=r,
+                           batch_size=batch, shuffle=shuffle, seed=seed)
+            for r in range(world)]
+
+
+def test_valid_positions_cover_dataset_exactly_once():
+    samplers = _all_ranks(1000, 8, 16)
+    idx = np.concatenate([s.epoch_indices(3)[0].ravel() for s in samplers])
+    valid = np.concatenate([s.epoch_indices(3)[1].ravel() for s in samplers])
+    assert sorted(idx[valid].tolist()) == list(range(1000))
+
+
+def test_equal_shard_sizes_and_static_shapes():
+    samplers = _all_ranks(1003, 8, 16)  # not divisible: wraparound pad
+    shapes = {s.epoch_indices(0)[0].shape for s in samplers}
+    assert shapes == {(samplers[0].batches_per_epoch, 16)}
+
+
+def test_epoch_keyed_reshuffle_and_determinism():
+    s = ShardedSampler(num_samples=512, world_size=4, rank=1, batch_size=8,
+                       shuffle=True, seed=1234)
+    e0a, _ = s.epoch_indices(0)
+    e0b, _ = s.epoch_indices(0)
+    e1, _ = s.epoch_indices(1)
+    np.testing.assert_array_equal(e0a, e0b)
+    assert e0a.tolist() != e1.tolist()
+
+
+def test_all_ranks_agree_on_global_permutation():
+    samplers = _all_ranks(256, 8, 4)
+    perms = [s.global_permutation(7) for s in samplers]
+    for p in perms[1:]:
+        np.testing.assert_array_equal(perms[0], p)
+
+
+def test_no_shuffle_is_identity_order():
+    s = ShardedSampler(num_samples=64, world_size=1, rank=0, batch_size=8,
+                       shuffle=False, seed=0)
+    idx, valid = s.epoch_indices(0)
+    np.testing.assert_array_equal(idx.ravel(), np.arange(64))
+    assert valid.all()
+
+
+def test_rank_out_of_range_rejected():
+    with pytest.raises(ValueError):
+        ShardedSampler(num_samples=10, world_size=2, rank=2, batch_size=2)
