@@ -1,0 +1,18 @@
+"""L3: model zoo (TPU-native replacement for ref utils.py:24-110).
+
+The reference wraps torchvision architectures and swaps their classifier
+heads to ``num_classes`` (ref utils.py:38-105).  Here each architecture is a
+Flax module built NHWC (XLA/TPU's native conv layout) with the final
+classifier uniformly named ``head`` — which makes the reference's
+``feature_extract`` backbone-freezing (ref utils.py:107-110) a one-line
+optax mask instead of a requires_grad walk (see registry.trainable_mask).
+
+BatchNorm uses per-replica statistics — deliberately matching DDP, which
+does not synchronize BN across ranks (SURVEY §7 step 4 decision point).
+"""
+
+from .registry import (get_model, get_model_input_size, head_mask_label,
+                       trainable_mask, MODEL_REGISTRY)
+
+__all__ = ["get_model", "get_model_input_size", "head_mask_label",
+           "trainable_mask", "MODEL_REGISTRY"]

@@ -1,0 +1,44 @@
+"""AlexNet (ref utils.py:51-58 wraps torchvision alexnet).
+
+Parity with torchvision's alexnet: five-conv feature stack, adaptive 6x6
+pool, dropout-4096-4096 classifier with the final layer replaced to
+``num_classes`` (the layer the reference swaps at utils.py:56-57 —
+named ``head`` here).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .common import adaptive_avg_pool
+
+
+class AlexNet(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        conv = lambda f, k, s, p: nn.Conv(  # noqa: E731
+            f, (k, k), strides=(s, s), padding=[(p, p), (p, p)],
+            dtype=self.dtype)
+        x = nn.relu(conv(64, 11, 4, 2)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(192, 5, 1, 2)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(384, 3, 1, 1)(x))
+        x = nn.relu(conv(256, 3, 1, 1)(x))
+        x = nn.relu(conv(256, 3, 1, 1)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = adaptive_avg_pool(x, 6)  # torchvision AdaptiveAvgPool2d((6,6))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,23 @@
+"""Shared model building blocks."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def adaptive_avg_pool(x: jax.Array, out_hw: int) -> jax.Array:
+    """NHWC adaptive average pool to (out_hw, out_hw).
+
+    Equivalent of torch's AdaptiveAvgPool2d for the exact-divisor case the
+    zoo hits at its canonical input sizes; falls back to a bilinear resize
+    of the mean-pooled map otherwise.
+    """
+    b, h, w, c = x.shape
+    if h == out_hw and w == out_hw:
+        return x
+    if h % out_hw == 0 and w % out_hw == 0:
+        kh, kw = h // out_hw, w // out_hw
+        return jnp.mean(
+            x.reshape(b, out_hw, kh, out_hw, kw, c), axis=(2, 4))
+    return jax.image.resize(x, (b, out_hw, out_hw, c), method="bilinear")

@@ -1,0 +1,68 @@
+"""DenseNet-121 (ref utils.py:78-85 wraps torchvision densenet121).
+
+Growth rate 32, block config (6, 12, 24, 16), bn_size 4, 0.5 transition
+compression — torchvision's densenet121 exactly; final dense layer (the one
+the reference replaces at utils.py:83-84) named ``head``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class DenseLayer(nn.Module):
+    growth: int
+    bn_size: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        norm = functools.partial(nn.BatchNorm, use_running_average=not train,
+                                 momentum=0.9, dtype=self.dtype)
+        y = nn.relu(norm()(x))
+        y = nn.Conv(self.bn_size * self.growth, (1, 1), use_bias=False,
+                    dtype=self.dtype)(y)
+        y = nn.relu(norm()(y))
+        y = nn.Conv(self.growth, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(y)
+        return jnp.concatenate([x, y], axis=-1)
+
+
+class DenseNet(nn.Module):
+    block_config: Sequence[int] = (6, 12, 24, 16)
+    growth: int = 32
+    bn_size: int = 4
+    num_init_features: int = 64
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = functools.partial(nn.BatchNorm, use_running_average=not train,
+                                 momentum=0.9, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.num_init_features, (7, 7), strides=(2, 2),
+                    padding=[(3, 3), (3, 3)], use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.relu(norm()(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, n_layers in enumerate(self.block_config):
+            for _ in range(n_layers):
+                x = DenseLayer(self.growth, self.bn_size, self.dtype)(x, train)
+            if i != len(self.block_config) - 1:  # transition
+                x = nn.relu(norm()(x))
+                x = nn.Conv(x.shape[-1] // 2, (1, 1), use_bias=False,
+                            dtype=self.dtype)(x)
+                x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(norm()(x))
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def densenet121(num_classes: int, dtype=jnp.bfloat16) -> DenseNet:
+    return DenseNet(num_classes=num_classes, dtype=dtype)

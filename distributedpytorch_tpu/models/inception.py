@@ -1,0 +1,188 @@
+"""Inception v3 with auxiliary logits (ref utils.py:87-99).
+
+Faithful to torchvision's inception_v3 topology: BasicConv (conv+BN+ReLU)
+stem, Mixed_5x (InceptionA), Mixed_6a (B), Mixed_6b-e (C), Mixed_7a (D),
+Mixed_7b-c (E), with AuxLogits branched off Mixed_6e during training.
+Both classifier heads are replaced to ``num_classes`` (ref utils.py:93-98):
+``head`` (primary fc) and ``aux_head`` (AuxLogits fc).  299x299 input
+(ref utils.py:89: "Be careful, expects (299,299) sized images").
+
+Train-mode call returns (logits, aux_logits) — consumed by the engine as
+``loss1 + 0.4 * loss2`` exactly like ref classif.py:49-53.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .common import adaptive_avg_pool
+
+
+class BasicConv(nn.Module):
+    filters: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "VALID"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.Conv(self.filters, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype)(x)
+        return nn.relu(x)
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding=[(1, 1), (1, 1)])
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train):
+        c = lambda f, k, p="VALID": BasicConv(f, k, padding=p,  # noqa: E731
+                                              dtype=self.dtype)
+        b1 = c(64, (1, 1))(x, train)
+        b5 = c(48, (1, 1))(x, train)
+        b5 = c(64, (5, 5), [(2, 2), (2, 2)])(b5, train)
+        b3 = c(64, (1, 1))(x, train)
+        b3 = c(96, (3, 3), [(1, 1), (1, 1)])(b3, train)
+        b3 = c(96, (3, 3), [(1, 1), (1, 1)])(b3, train)
+        bp = c(self.pool_features, (1, 1))(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train):
+        b3 = BasicConv(384, (3, 3), (2, 2), dtype=self.dtype)(x, train)
+        bd = BasicConv(64, (1, 1), dtype=self.dtype)(x, train)
+        bd = BasicConv(96, (3, 3), padding=[(1, 1), (1, 1)],
+                       dtype=self.dtype)(bd, train)
+        bd = BasicConv(96, (3, 3), (2, 2), dtype=self.dtype)(bd, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    channels_7x7: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train):
+        c7 = self.channels_7x7
+        h = [(0, 0), (3, 3)]   # padding for 1x7
+        v = [(3, 3), (0, 0)]   # padding for 7x1
+        b1 = BasicConv(192, (1, 1), dtype=self.dtype)(x, train)
+        b7 = BasicConv(c7, (1, 1), dtype=self.dtype)(x, train)
+        b7 = BasicConv(c7, (1, 7), padding=h, dtype=self.dtype)(b7, train)
+        b7 = BasicConv(192, (7, 1), padding=v, dtype=self.dtype)(b7, train)
+        bd = BasicConv(c7, (1, 1), dtype=self.dtype)(x, train)
+        bd = BasicConv(c7, (7, 1), padding=v, dtype=self.dtype)(bd, train)
+        bd = BasicConv(c7, (1, 7), padding=h, dtype=self.dtype)(bd, train)
+        bd = BasicConv(c7, (7, 1), padding=v, dtype=self.dtype)(bd, train)
+        bd = BasicConv(192, (1, 7), padding=h, dtype=self.dtype)(bd, train)
+        bp = BasicConv(192, (1, 1), dtype=self.dtype)(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train):
+        b3 = BasicConv(192, (1, 1), dtype=self.dtype)(x, train)
+        b3 = BasicConv(320, (3, 3), (2, 2), dtype=self.dtype)(b3, train)
+        b7 = BasicConv(192, (1, 1), dtype=self.dtype)(x, train)
+        b7 = BasicConv(192, (1, 7), padding=[(0, 0), (3, 3)],
+                       dtype=self.dtype)(b7, train)
+        b7 = BasicConv(192, (7, 1), padding=[(3, 3), (0, 0)],
+                       dtype=self.dtype)(b7, train)
+        b7 = BasicConv(192, (3, 3), (2, 2), dtype=self.dtype)(b7, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train):
+        b1 = BasicConv(320, (1, 1), dtype=self.dtype)(x, train)
+        b3 = BasicConv(384, (1, 1), dtype=self.dtype)(x, train)
+        b3 = jnp.concatenate([
+            BasicConv(384, (1, 3), padding=[(0, 0), (1, 1)],
+                      dtype=self.dtype)(b3, train),
+            BasicConv(384, (3, 1), padding=[(1, 1), (0, 0)],
+                      dtype=self.dtype)(b3, train),
+        ], axis=-1)
+        bd = BasicConv(448, (1, 1), dtype=self.dtype)(x, train)
+        bd = BasicConv(384, (3, 3), padding=[(1, 1), (1, 1)],
+                       dtype=self.dtype)(bd, train)
+        bd = jnp.concatenate([
+            BasicConv(384, (1, 3), padding=[(0, 0), (1, 1)],
+                      dtype=self.dtype)(bd, train),
+            BasicConv(384, (3, 1), padding=[(1, 1), (0, 0)],
+                      dtype=self.dtype)(bd, train),
+        ], axis=-1)
+        bp = BasicConv(192, (1, 1), dtype=self.dtype)(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class AuxHead(nn.Module):
+    num_classes: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train):
+        x = nn.avg_pool(x, (5, 5), strides=(3, 3))
+        x = BasicConv(128, (1, 1), dtype=self.dtype)(x, train)
+        x = BasicConv(768, (5, 5), dtype=self.dtype)(x, train)
+        x = adaptive_avg_pool(x, 1).reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        name="aux_head")(x)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False
+                 ) -> Union[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+        x = x.astype(self.dtype)
+        x = BasicConv(32, (3, 3), (2, 2), dtype=self.dtype)(x, train)
+        x = BasicConv(32, (3, 3), dtype=self.dtype)(x, train)
+        x = BasicConv(64, (3, 3), padding=[(1, 1), (1, 1)],
+                      dtype=self.dtype)(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = BasicConv(80, (1, 1), dtype=self.dtype)(x, train)
+        x = BasicConv(192, (3, 3), dtype=self.dtype)(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = InceptionA(32, self.dtype)(x, train)
+        x = InceptionA(64, self.dtype)(x, train)
+        x = InceptionA(64, self.dtype)(x, train)
+        x = InceptionB(self.dtype)(x, train)
+        for c7 in (128, 160, 160, 192):
+            x = InceptionC(c7, self.dtype)(x, train)
+        aux = AuxHead(self.num_classes, self.dtype)(x, train) if train \
+            else None
+        x = InceptionD(self.dtype)(x, train)
+        x = InceptionE(self.dtype)(x, train)
+        x = InceptionE(self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        x = x.astype(jnp.float32)
+        if train:
+            return x, aux.astype(jnp.float32)
+        return x

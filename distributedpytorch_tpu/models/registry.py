@@ -1,0 +1,88 @@
+"""Model registry: name -> module / input size / freeze mask.
+
+Replaces ref utils.py getModel (:38-105), getModelInputSize (:24-36) and
+setParameterRequiresGrad (:107-110).  Invalid names raise ValueError (the
+reference logs and exit()s; callers map this to the same behavior).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .alexnet import AlexNet
+from .densenet import densenet121
+from .inception import InceptionV3
+from .resnet import resnet18
+from .simple import MLP, SmallCNN
+from .squeezenet import SqueezeNet
+from .vgg import VGG11BN
+
+MODEL_REGISTRY: Dict[str, Callable[..., nn.Module]] = {
+    "cnn": lambda n, d: SmallCNN(num_classes=n, dtype=d),
+    "mlp": lambda n, d: MLP(num_classes=n, dtype=d),
+    "resnet": lambda n, d: resnet18(n, d),           # ref utils.py:42-49
+    "alexnet": lambda n, d: AlexNet(num_classes=n, dtype=d),   # :51-58
+    "vgg": lambda n, d: VGG11BN(num_classes=n, dtype=d),       # :60-67
+    "squeezenet": lambda n, d: SqueezeNet(num_classes=n, dtype=d),  # :69-76
+    "densenet": lambda n, d: densenet121(n, d),      # :78-85
+    "inception": lambda n, d: InceptionV3(num_classes=n, dtype=d),  # :87-99
+}
+
+# name -> input resolution (ref getModelInputSize, utils.py:24-36: 224 for
+# all but inception=299; cnn/mlp run at the dataset-native 28).
+_INPUT_SIZES = {
+    "cnn": 28, "mlp": 28, "resnet": 224, "alexnet": 224, "vgg": 224,
+    "squeezenet": 224, "densenet": 224, "inception": 299,
+}
+
+# Models whose train-mode forward also returns auxiliary logits
+# (ref classif.py:49-53 special-cases 'inception').
+AUX_LOGIT_MODELS = frozenset({"inception"})
+
+# Models using dropout (their apply() needs a 'dropout' rng in train mode).
+DROPOUT_MODELS = frozenset({"alexnet", "vgg", "squeezenet", "inception"})
+
+
+def get_model(name: str, num_classes: int,
+              half_precision: bool = True) -> nn.Module:
+    if name not in MODEL_REGISTRY:
+        raise ValueError(f"Invalid model name {name!r} "
+                         f"(choices: {sorted(MODEL_REGISTRY)})")
+    dtype = jnp.bfloat16 if half_precision else jnp.float32
+    return MODEL_REGISTRY[name](num_classes, dtype)
+
+
+def get_model_input_size(name: str) -> int:
+    if name not in _INPUT_SIZES:
+        raise ValueError(f"Invalid model name {name!r}")
+    return _INPUT_SIZES[name]
+
+
+def head_mask_label(path: tuple, _leaf: Any = None) -> str:
+    """'head' for classifier-head params, 'backbone' otherwise.
+
+    Every zoo model names its replaced classifier ``head`` (and inception's
+    auxiliary classifier ``aux_head``), so the freeze decision is purely
+    structural — the JAX analogue of the reference replacing layers *after*
+    the requires_grad=False sweep (ref utils.py:46-48 etc.).
+    """
+    in_head = any(
+        isinstance(k, str) and (k == "head" or k == "aux_head")
+        or getattr(k, "key", None) in ("head", "aux_head")
+        for k in path)
+    return "head" if in_head else "backbone"
+
+
+def trainable_mask(params) -> Any:
+    """Pytree of {'head','backbone'} labels for optax.multi_transform.
+
+    feature_extract=True (ref config.py:48, utils.py:107-110) maps
+    'backbone' to optax.set_to_zero() so only the head trains.
+    """
+    import jax
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: head_mask_label(path, leaf), params)

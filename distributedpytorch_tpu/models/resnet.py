@@ -1,0 +1,69 @@
+"""ResNet-18 (ref utils.py:42-49 wraps torchvision resnet18).
+
+Architecture parity with torchvision resnet18: 7x7/2 stem + 3x3/2 maxpool,
+four stages of two BasicBlocks at widths (64,128,256,512), stride-2
+downsampling with 1x1 projection at each stage entry, global average pool,
+dense ``head`` (the layer the reference replaces, ref utils.py:47-48).
+NHWC, BN with per-replica stats (DDP parity — no cross-replica sync).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    stride: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        norm = functools.partial(nn.BatchNorm, use_running_average=not train,
+                                 momentum=0.9, dtype=self.dtype)
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (3, 3), strides=(self.stride, self.stride),
+                 padding="SAME")(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3), padding="SAME")(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1),
+                            strides=(self.stride, self.stride))(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 10
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block in range(n_blocks):
+                stride = 2 if stage > 0 and block == 0 else 1
+                x = BasicBlock(self.width * (2 ** stage), stride,
+                               self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def resnet18(num_classes: int, dtype=jnp.bfloat16) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), num_classes=num_classes,
+                  dtype=dtype)

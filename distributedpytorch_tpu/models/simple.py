@@ -1,0 +1,52 @@
+"""Small MNIST-scale models: the benchmark flagships.
+
+These are this framework's additions beyond the reference zoo (BASELINE.md
+configs 1-3 name "MNIST CNN" and "MNIST MLP" as the primary benchmark
+models): they run at native 28x28 so the north-star samples/sec/chip metric
+measures the framework, not a 224x224 upsample.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class SmallCNN(nn.Module):
+    """Conv-conv-pool x2 + dense.  Channel widths are multiples of 32/64 so
+    XLA tiles the im2col matmuls cleanly onto the 128x128 MXU."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for width in (32, 64):
+            x = nn.Conv(width, (3, 3), padding="SAME", dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.Conv(width, (3, 3), padding="SAME", dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(256, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+class MLP(nn.Module):
+    """784->512->256->classes; exercises pure-dense allreduce
+    (BASELINE.md config 3: 'non-conv param allreduce')."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype).reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(256, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)

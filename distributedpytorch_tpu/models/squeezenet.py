@@ -1,0 +1,55 @@
+"""SqueezeNet 1.0 (ref utils.py:69-76 wraps torchvision squeezenet1_0).
+
+Fire modules (squeeze 1x1 -> expand 1x1 + 3x3 concat); the classifier is a
+dropout + 1x1 conv to ``num_classes`` + ReLU + global average pool — the
+conv is exactly the layer the reference replaces (ref utils.py:74), named
+``head`` here.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Fire(nn.Module):
+    squeeze: int
+    expand1: int
+    expand3: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Conv(self.squeeze, (1, 1), dtype=self.dtype)(x))
+        e1 = nn.relu(nn.Conv(self.expand1, (1, 1), dtype=self.dtype)(x))
+        e3 = nn.relu(nn.Conv(self.expand3, (3, 3), padding="SAME",
+                             dtype=self.dtype)(x))
+        return jnp.concatenate([e1, e3], axis=-1)
+
+
+class SqueezeNet(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(96, (7, 7), strides=(2, 2), dtype=self.dtype)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = Fire(16, 64, 64, self.dtype)(x)
+        x = Fire(16, 64, 64, self.dtype)(x)
+        x = Fire(32, 128, 128, self.dtype)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = Fire(32, 128, 128, self.dtype)(x)
+        x = Fire(48, 192, 192, self.dtype)(x)
+        x = Fire(48, 192, 192, self.dtype)(x)
+        x = Fire(64, 256, 256, self.dtype)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = Fire(64, 256, 256, self.dtype)(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Conv(self.num_classes, (1, 1), dtype=self.dtype,
+                            name="head")(x))
+        x = jnp.mean(x, axis=(1, 2))
+        return x.astype(jnp.float32)

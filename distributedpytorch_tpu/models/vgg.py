@@ -1,0 +1,44 @@
+"""VGG11 with BatchNorm (ref utils.py:60-67 wraps torchvision vgg11_bn).
+
+Config 'A': convs (64, M, 128, M, 256, 256, M, 512, 512, M, 512, 512, M),
+each conv followed by BN+ReLU; adaptive 7x7 pool; 4096-4096 classifier with
+dropout and the final layer (the one the reference replaces at
+utils.py:65-66) named ``head``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .common import adaptive_avg_pool
+
+_VGG11 = (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M")
+
+
+class VGG11BN(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for v in _VGG11:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding="SAME", use_bias=False,
+                            dtype=self.dtype)(x)
+                x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 dtype=self.dtype)(x)
+                x = nn.relu(x)
+        x = adaptive_avg_pool(x, 7)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
