@@ -22,3 +22,7 @@ if os.environ.get("DPT_TESTS_ON_TPU") != "1":
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # One synchronous dispatch at a time: with a single host core, queueing
+    # several 8-participant collective programs can starve XLA:CPU's 40s
+    # rendezvous (observed as SIGABRT in rendezvous.cc).
+    jax.config.update("jax_cpu_enable_async_dispatch", False)

@@ -78,8 +78,13 @@ def load_dataset(name: str, data_path: str, seed: int,
     perm = np.random.default_rng(seed).permutation(n)
     tr_idx, va_idx = perm[:n_train], perm[n_train:]
 
-    if debug:  # ref dataloader.py:139-144
+    if debug:
+        # ref dataloader.py:139-144 truncates train to 200; the valid/test
+        # truncations the reference left commented out are enabled here so
+        # --debug is a true smoke mode (divergence documented in README).
         tr_idx = tr_idx[:DEBUG_SUBSET]
+        va_idx = va_idx[:DEBUG_SUBSET]
+        te_x, te_y = te_x[:DEBUG_SUBSET], te_y[:DEBUG_SUBSET]
 
     ds = Dataset(
         name=name,

@@ -1,0 +1,7 @@
+"""Losses and metrics as pure functions (ref utils.py:142-162, classif.py:106-120)."""
+
+from .losses import get_loss_fn, cross_entropy, weighted_cross_entropy, focal_loss
+from .metrics import per_example_correct
+
+__all__ = ["get_loss_fn", "cross_entropy", "weighted_cross_entropy",
+           "focal_loss", "per_example_correct"]

@@ -1,0 +1,5 @@
+"""L4: training/eval engine (TPU-native replacement for ref classif.py)."""
+
+from .engine import Engine, TrainState
+
+__all__ = ["Engine", "TrainState"]

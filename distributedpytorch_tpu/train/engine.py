@@ -1,0 +1,192 @@
+"""The SPMD train/eval engine — the heart of the framework.
+
+Replaces the reference's DDP machinery (ref classif.py:28-71 processData,
+:122-138 optimizer + DistributedDataParallel wrap).  Where DDP hijacks
+``loss.backward()`` to bucket-allreduce gradients over NCCL, here the whole
+step — on-device augmentation, forward, loss, backward, gradient reduction,
+optimizer update — is ONE jit-compiled XLA program over the device mesh:
+the batch is sharded along the 'data' axis, params/optimizer state are
+replicated, and XLA inserts the gradient all-reduce over ICI automatically
+(the computation is expressed on *global* arrays; the collective appears
+exactly where DDP's hidden allreduce was, but fused and overlapped by the
+compiler).  tests/test_distributed.py proves the semantics: the sharded
+step's gradients equal a single-device big-batch step's.
+
+Design choices with reference citations:
+  * aux-logit models (inception): loss = loss1 + 0.4*loss2
+    (ref classif.py:49-53);
+  * optimizers: Adam(lr=1e-3) | SGD(lr=1e-3, momentum=0.9) with per-epoch
+    StepLR(gamma=0.1) for SGD only (ref classif.py:122-131) — expressed as
+    an optax exponential_decay schedule with staircase per epoch;
+  * feature_extract freezes the backbone via optax.multi_transform +
+    set_to_zero over the structural head/backbone mask
+    (ref utils.py:107-110, config.py:48);
+  * metrics are *globally* reduced inside the step (fixes SURVEY defect #9:
+    the reference reports rank-local, never-reduced loss/accuracy);
+  * per-batch metric scalars stay on device; the driver syncs at most a few
+    times per epoch (the reference's per-batch ``.item()`` at
+    classif.py:61-62 forces a device sync every step).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..data import augment
+from ..models.registry import (AUX_LOGIT_MODELS, DROPOUT_MODELS,
+                               trainable_mask)
+from ..ops import per_example_correct
+from ..ops.losses import LossFn
+
+
+class TrainState(flax.struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+
+
+def make_optimizer(optimizer: str, learning_rate: float, momentum: float,
+                   lr_step_gamma: float, steps_per_epoch: int,
+                   feature_extract: bool) -> optax.GradientTransformation:
+    """Optimizer dispatch (ref classif.py:122-131)."""
+    if optimizer == "adam":
+        base = optax.adam(learning_rate)  # torch Adam defaults match optax
+    elif optimizer == "SGD":
+        # StepLR(step_size=1, gamma) per epoch == staircase exponential decay
+        # every steps_per_epoch steps (ref classif.py:128,168-169).
+        schedule = optax.exponential_decay(
+            init_value=learning_rate,
+            transition_steps=max(1, steps_per_epoch),
+            decay_rate=lr_step_gamma,
+            staircase=True)
+        base = optax.sgd(schedule, momentum=momentum)
+    else:
+        raise ValueError(f"Invalid optimizer {optimizer!r}")
+    if feature_extract:
+        return optax.multi_transform(
+            {"head": base, "backbone": optax.set_to_zero()},
+            trainable_mask)
+    return base
+
+
+class Engine:
+    """Builds and owns the jitted SPMD steps for one (model, config) pair."""
+
+    def __init__(self, model, model_name: str, loss_fn: LossFn,
+                 tx: optax.GradientTransformation, mean: float, std: float,
+                 input_size: int, half_precision: bool = True):
+        self.model = model
+        self.model_name = model_name
+        self.loss_fn = loss_fn
+        self.tx = tx
+        self.mean = float(mean)
+        self.std = float(std)
+        self.input_size = int(input_size)
+        self.compute_dtype = jnp.bfloat16 if half_precision else jnp.float32
+        self.has_aux = model_name in AUX_LOGIT_MODELS
+        self.uses_dropout = model_name in DROPOUT_MODELS
+        self.train_step = jax.jit(self._train_step, donate_argnums=0)
+        self.eval_step = jax.jit(self._eval_step)
+
+    # -- state ------------------------------------------------------------
+
+    def init_state(self, key: jax.Array, channels: int) -> TrainState:
+        x = jnp.zeros((2, self.input_size, self.input_size, 3),
+                      self.compute_dtype)
+        variables = jax.jit(
+            functools.partial(self.model.init, train=True)
+        )({"params": key, "dropout": jax.random.fold_in(key, 1)}, x)
+        params = variables["params"]
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=variables.get("batch_stats", {}),
+            opt_state=self.tx.init(params),
+        )
+
+    # -- shared pieces ----------------------------------------------------
+
+    def _apply(self, params, batch_stats, imgs, train: bool,
+               dropout_key: Optional[jax.Array]):
+        variables = {"params": params}
+        has_bn = len(jax.tree_util.tree_leaves(batch_stats)) > 0
+        if has_bn:
+            variables["batch_stats"] = batch_stats
+        rngs = ({"dropout": dropout_key}
+                if (train and self.uses_dropout) else None)
+        if train and has_bn:
+            out, updated = self.model.apply(
+                variables, imgs, train=True, rngs=rngs,
+                mutable=["batch_stats"])
+            return out, updated["batch_stats"]
+        out = self.model.apply(variables, imgs, train=train, rngs=rngs)
+        return out, batch_stats
+
+    def _reduce_loss(self, logits, labels, vmask):
+        numer, denom = self.loss_fn(logits, labels)
+        return (jnp.sum(numer * vmask)
+                / jnp.maximum(jnp.sum(denom * vmask), 1e-9))
+
+    # -- steps ------------------------------------------------------------
+
+    def _train_step(self, state: TrainState, images_u8, labels, valid,
+                    key: jax.Array
+                    ) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        step_key = jax.random.fold_in(key, state.step)
+        aug_key, dropout_key = jax.random.split(step_key)
+        imgs = augment.train_transform(
+            aug_key, images_u8, self.mean, self.std, self.input_size,
+            out_dtype=self.compute_dtype)
+        vmask = valid.astype(jnp.float32)
+
+        def compute_loss(params):
+            out, new_bs = self._apply(params, state.batch_stats, imgs,
+                                      True, dropout_key)
+            if self.has_aux:
+                logits, aux_logits = out
+                loss = (self._reduce_loss(logits, labels, vmask)
+                        + 0.4 * self._reduce_loss(aux_logits, labels, vmask))
+            else:
+                logits = out
+                loss = self._reduce_loss(logits, labels, vmask)
+            return loss, (logits, new_bs)
+
+        (loss, (logits, new_bs)), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(state.params)
+        updates, new_opt_state = self.tx.update(grads, state.opt_state,
+                                                state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        correct = per_example_correct(logits, labels) * vmask
+        metrics = {
+            "loss": loss,
+            "correct": jnp.sum(correct),
+            "valid": jnp.sum(vmask),
+        }
+        return state.replace(step=state.step + 1, params=new_params,
+                             batch_stats=new_bs,
+                             opt_state=new_opt_state), metrics
+
+    def _eval_step(self, state: TrainState, images_u8, labels, valid
+                   ) -> Dict[str, jax.Array]:
+        imgs = augment.eval_transform(images_u8, self.mean, self.std,
+                                      self.input_size,
+                                      out_dtype=self.compute_dtype)
+        vmask = valid.astype(jnp.float32)
+        out, _ = self._apply(state.params, state.batch_stats, imgs,
+                             False, None)
+        logits = out[0] if isinstance(out, tuple) else out
+        numer, denom = self.loss_fn(logits, labels)
+        correct = per_example_correct(logits, labels) * vmask
+        return {
+            "loss_numer": jnp.sum(numer * vmask),
+            "loss_denom": jnp.sum(denom * vmask),
+            "correct": jnp.sum(correct),
+            "valid": jnp.sum(vmask),
+        }

@@ -1,0 +1,17 @@
+#!/usr/bin/env python3
+"""Entry point — north-star contract (BASELINE.json):
+
+    python main.py train -d $DATAPATH
+    python main.py test  -d $DATAPATH -f $MODELFILE
+
+TPU-native re-design of georand/distributedpytorch's main.py: no IP table,
+no process spawn — topology comes from the JAX runtime (see
+distributedpytorch_tpu/runtime.py).
+"""
+
+import sys
+
+from distributedpytorch_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,84 @@
+"""Loss zoo parity against torch (CPU torch is available in the image).
+
+The reference's losses are torch.nn.CrossEntropyLoss (ref classif.py:110),
+CrossEntropyLoss(weight) (:112) and FocalLossN (ref utils.py:142-156);
+these tests pin our pure-JAX implementations to torch's numerics.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from distributedpytorch_tpu.ops import losses  # noqa: E402
+
+
+@pytest.fixture
+def batch():
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(16, 10)).astype(np.float32)
+    labels = rng.integers(0, 10, size=(16,)).astype(np.int64)
+    weights = rng.uniform(0.5, 2.0, size=(10,)).astype(np.float32)
+    return logits, labels, weights
+
+
+def _scalar(numer, denom):
+    return float(jnp.sum(numer) / jnp.sum(denom))
+
+
+def test_cross_entropy_matches_torch(batch):
+    logits, labels, _ = batch
+    ours = _scalar(*losses.cross_entropy(jnp.asarray(logits),
+                                         jnp.asarray(labels)))
+    ref = torch.nn.CrossEntropyLoss()(torch.tensor(logits),
+                                      torch.tensor(labels)).item()
+    assert ours == pytest.approx(ref, rel=1e-5)
+
+
+def test_weighted_cross_entropy_matches_torch(batch):
+    logits, labels, weights = batch
+    ours = _scalar(*losses.weighted_cross_entropy(
+        jnp.asarray(logits), jnp.asarray(labels), jnp.asarray(weights)))
+    ref = torch.nn.CrossEntropyLoss(weight=torch.tensor(weights))(
+        torch.tensor(logits), torch.tensor(labels)).item()
+    assert ours == pytest.approx(ref, rel=1e-5)
+
+
+def test_focal_loss_matches_reference_formula(batch):
+    """Pin to FocalLossN's exact computation (ref utils.py:150-156):
+    nll_loss((1-p)^gamma * log_softmax, weight, reduction='none').mean()."""
+    logits, labels, weights = batch
+    t_logits, t_labels = torch.tensor(logits), torch.tensor(labels)
+    log_prob = torch.nn.functional.log_softmax(t_logits, dim=-1)
+    prob = torch.exp(log_prob)
+    ref = torch.nn.functional.nll_loss(
+        ((1 - prob) ** 2.0) * log_prob, t_labels,
+        weight=torch.tensor(weights), reduction="none").mean().item()
+    ours = _scalar(*losses.focal_loss(jnp.asarray(logits),
+                                      jnp.asarray(labels),
+                                      jnp.asarray(weights), gamma=2.0))
+    assert ours == pytest.approx(ref, rel=1e-5)
+
+
+def test_focal_loss_unweighted(batch):
+    logits, labels, _ = batch
+    t_logits, t_labels = torch.tensor(logits), torch.tensor(labels)
+    log_prob = torch.nn.functional.log_softmax(t_logits, dim=-1)
+    prob = torch.exp(log_prob)
+    ref = torch.nn.functional.nll_loss(
+        ((1 - prob) ** 2.0) * log_prob, t_labels,
+        reduction="none").mean().item()
+    ours = _scalar(*losses.focal_loss(jnp.asarray(logits),
+                                      jnp.asarray(labels), None, 2.0))
+    assert ours == pytest.approx(ref, rel=1e-5)
+
+
+def test_dispatch_and_invalid_name():
+    fn = losses.get_loss_fn("cross_entropy")
+    n, d = fn(jnp.zeros((2, 3)), jnp.array([0, 1]))
+    assert n.shape == (2,) and d.shape == (2,)
+    with pytest.raises(ValueError, match="Invalid loss"):
+        losses.get_loss_fn("nope")
+    with pytest.raises(ValueError, match="requires class weights"):
+        losses.get_loss_fn("weighted_cross_entropy")
