@@ -79,6 +79,10 @@ class Config:
     prefetch: int = NUM_WORKERS            # device prefetch depth
     half_precision: bool = True            # bfloat16 compute on TPU (MXU-native)
     focal_gamma: float = 2.0               # ref utils.py:144
+    # 'resident': split lives in HBM, one XLA dispatch per epoch;
+    # 'stream': host batching + prefetch; 'auto' picks by size.
+    data_mode: str = "auto"
+    resident_max_bytes: int = 512 * 1024 * 1024
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -108,6 +112,9 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                    help=f"results/checkpoint dir (default: {RSL_PATH})")
     p.add_argument("--no-bf16", action="store_true",
                    help="disable bfloat16 compute (use float32)")
+    p.add_argument("--data-mode", choices=("auto", "stream", "resident"),
+                   default="auto", dest="dataMode",
+                   help="device-resident vs streamed batches (default: auto)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -150,4 +157,5 @@ def config_from_argv(argv=None) -> Config:
         checkpoint_file=args.checkpointFile,
         debug=args.debug,
         half_precision=not args.no_bf16,
+        data_mode=args.dataMode,
     )
