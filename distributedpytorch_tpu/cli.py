@@ -180,15 +180,29 @@ def run_train(cfg: Config) -> dict:
                   f"======================")
         epoch_start = utils.monotonic()
 
+        # SURVEY §5 tracing equivalent: trace the first post-compile epoch.
+        tracing = cfg.profile and epoch == start_epoch + 1
+        if tracing:
+            jax.profiler.start_trace(f"{cfg.rsl_path}/trace")
+
         epoch_key = utils.fold_key(root, epoch)
         state, train_loss, train_acc = _run_train_pass(
             engine, state, train_loader, epoch, epoch_key)
+        train_end = utils.monotonic()
         valid_loss, valid_acc = _run_eval_pass(
             engine, state, valid_loader, epoch)
+
+        if tracing:
+            jax.profiler.stop_trace()
+            if runtime.is_main():
+                logging.info(f"profiler trace written to "
+                             f"{cfg.rsl_path}/trace")
 
         end = utils.monotonic()
         epoch_mins, epoch_secs = utils.get_duration(epoch_start, end)
         mins, _secs = utils.get_duration(start_time, end)
+        train_samples = len(train_loader) * train_loader.global_batch
+        sps_chip = train_samples / max(train_end - epoch_start, 1e-9) / world
 
         if runtime.is_main():  # ref classif.py:176-192
             improved = valid_loss < best_valid_loss
@@ -200,6 +214,9 @@ def run_train(cfg: Config) -> dict:
                          f"| Acc: {train_acc * 100:.2f}%")
             logging.info(f"  Validation  | Loss: {valid_loss:.5f}       "
                          f"| Acc: {valid_acc * 100:.2f}%")
+            # North-star metric surfaced per epoch (BASELINE.md).
+            logging.info(f"  Throughput  | {sps_chip:,.0f} samples/s/chip "
+                         f"({world} chip{'s' if world > 1 else ''})")
             ckpt.rotate_checkpoint(cfg.rsl_path, cfg.dataset, model_name,
                                    epoch)
             ckpt.save_checkpoint(

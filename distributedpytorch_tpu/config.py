@@ -83,6 +83,7 @@ class Config:
     # 'stream': host batching + prefetch; 'auto' picks by size.
     data_mode: str = "auto"
     resident_max_bytes: int = 512 * 1024 * 1024
+    profile: bool = False                  # jax.profiler trace of one epoch
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -115,6 +116,9 @@ def _common_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--data-mode", choices=("auto", "stream", "resident"),
                    default="auto", dest="dataMode",
                    help="device-resident vs streamed batches (default: auto)")
+    p.add_argument("--profile", action="store_true",
+                   help="write a jax.profiler trace of the second epoch "
+                        "to RSL_PATH/trace")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -158,4 +162,5 @@ def config_from_argv(argv=None) -> Config:
         debug=args.debug,
         half_precision=not args.no_bf16,
         data_mode=args.dataMode,
+        profile=args.profile,
     )
