@@ -63,7 +63,7 @@ def test_sharded_step_equals_single_device_step(model_name):
     assert float(m8["correct"]) == float(m1["correct"])
     for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(s8.params)),
                     jax.tree_util.tree_leaves(jax.device_get(s1.params))):
-        np.testing.assert_allclose(a, b, atol=1e-5)
+        np.testing.assert_allclose(a, b, atol=5e-5)  # compiler reassociation
 
 
 def test_uneven_world_metrics_are_global():
