@@ -1,0 +1,21 @@
+"""The driver entry points must keep working: compile-check + dry-run."""
+
+import jax
+import numpy as np
+
+import __graft_entry__ as graft
+
+
+def test_entry_forward_is_jittable():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (8, 10)
+    assert bool(np.isfinite(np.asarray(out)).all())
+
+
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_2():
+    graft.dryrun_multichip(2)
