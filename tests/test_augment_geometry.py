@@ -1,0 +1,76 @@
+"""Pin the matmul-formulated warp to classical bilinear sampling.
+
+The gather-free hat-matrix formulation in augment._warp_one must produce
+exactly the same image as a straightforward numpy bilinear sampler for the
+same affine parameters (rotation about center + crop-box resize with
+half-pixel convention, zero fill outside) — i.e. the MXU-friendly rewrite
+changed the execution strategy, not the math.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributedpytorch_tpu.data import augment
+
+
+def _numpy_bilinear_warp(img, theta, y0, x0, crop_h, crop_w, out_dim):
+    h, w = img.shape
+    ii = np.arange(out_dim, dtype=np.float64)
+    ys = y0 + (ii[:, None] + 0.5) * crop_h / out_dim - 0.5
+    xs = x0 + (ii[None, :] + 0.5) * crop_w / out_dim - 0.5
+    ys = np.broadcast_to(ys, (out_dim, out_dim))
+    xs = np.broadcast_to(xs, (out_dim, out_dim))
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    cos_t, sin_t = np.cos(-theta), np.sin(-theta)
+    sy = cos_t * (ys - cy) - sin_t * (xs - cx) + cy
+    sx = sin_t * (ys - cy) + cos_t * (xs - cx) + cx
+
+    out = np.zeros((out_dim, out_dim))
+    for i in range(out_dim):
+        for j in range(out_dim):
+            y, x = sy[i, j], sx[i, j]
+            acc = 0.0
+            fy, fx = int(np.floor(y)), int(np.floor(x))
+            for yy in (fy, fy + 1):
+                for xx in (fx, fx + 1):
+                    if 0 <= yy < h and 0 <= xx < w:
+                        wgt = max(0.0, 1 - abs(y - yy)) * \
+                            max(0.0, 1 - abs(x - xx))
+                        acc += wgt * img[yy, xx]
+            out[i, j] = acc  # zero fill outside (RandomRotation fill=0)
+    return out
+
+
+def test_warp_matches_numpy_bilinear_reference():
+    rng = np.random.default_rng(0)
+    img = rng.uniform(0, 1, size=(28, 28)).astype(np.float32)
+    key = jax.random.PRNGKey(11)
+    params = jax.device_get(augment._sample_affine(key, 28, 28))
+    theta, y0, x0, crop_h, crop_w = (float(p) for p in params)
+
+    ours = np.asarray(augment._warp_one(jnp.asarray(img), key, 28))
+    ref = _numpy_bilinear_warp(img, theta, y0, x0, crop_h, crop_w, 28)
+    np.testing.assert_allclose(ours, ref, atol=1e-4)
+
+
+def test_identity_affine_is_identity():
+    """crop == full image, theta == 0 -> output equals input exactly."""
+    rng = np.random.default_rng(1)
+    img = rng.uniform(0, 1, size=(28, 28)).astype(np.float32)
+    ref = _numpy_bilinear_warp(img, 0.0, 0.0, 0.0, 28.0, 28.0, 28)
+    np.testing.assert_allclose(ref, img, atol=1e-12)
+
+
+def test_sampled_params_within_torchvision_ranges():
+    keys = jax.random.split(jax.random.PRNGKey(0), 64)
+    for k in keys:
+        theta, y0, x0, ch, cw = (
+            float(v) for v in jax.device_get(augment._sample_affine(k, 28, 28)))
+        assert abs(theta) <= np.deg2rad(5.0) + 1e-6  # ref dataloader.py:102
+        assert 1.0 <= ch <= 28.0 and 1.0 <= cw <= 28.0
+        assert 0.0 <= y0 <= 28.0 - ch + 1e-5
+        assert 0.0 <= x0 <= 28.0 - cw + 1e-5
+        # torchvision RandomResizedCrop scale bounds: area in [0.08, 1]*HW
+        area = ch * cw / (28.0 * 28.0)
+        assert 0.05 <= area <= 1.0 + 1e-6

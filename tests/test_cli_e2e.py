@@ -8,6 +8,7 @@ subset (the reference's own smoke mode, ref dataloader.py:139-144).
 
 import os
 
+import numpy as np
 import pytest
 
 from distributedpytorch_tpu import checkpoint as ckpt
@@ -56,6 +57,28 @@ def test_test_subcommand_loads_best_model(trained):
     result = run_test(cfg_t)
     assert result["model_name"] == "cnn"
     assert 0.0 <= result["test_acc"] <= 1.0
+
+
+def test_streaming_mode_e2e(tmp_path):
+    """Force the streamed (host-batched, prefetching) pipeline through the
+    driver — the path larger-than-HBM corpora take."""
+    cfg = Config(action="train", data_path="/tmp/nodata",
+                 rsl_path=str(tmp_path), dataset="synthetic",
+                 model_name="mlp", batch_size=8, nb_epochs=1, debug=True,
+                 half_precision=False, data_mode="stream")
+    result = run_train(cfg)
+    assert len(result["history"]) == 1
+    assert np.isfinite(result["history"][0]["train_loss"])
+
+
+def test_focal_loss_cli_e2e(tmp_path):
+    """--loss focal_loss works end-to-end (reference crashes: defect #4)."""
+    cfg = Config(action="train", data_path="/tmp/nodata",
+                 rsl_path=str(tmp_path), dataset="synthetic",
+                 model_name="mlp", batch_size=8, nb_epochs=1, debug=True,
+                 half_precision=False, loss="focal_loss")
+    result = run_train(cfg)
+    assert np.isfinite(result["history"][0]["train_loss"])
 
 
 def test_cli_parser_matches_reference_surface():
