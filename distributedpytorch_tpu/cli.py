@@ -131,6 +131,93 @@ def _run_train_pass(engine: Engine, state, loader, epoch: int, key
     return state, epoch_loss, epoch_acc
 
 
+def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
+                       valid_loader, model_name: str, root, start_epoch: int,
+                       best_valid_loss: float, start_time: float,
+                       world: int) -> dict:
+    """--epochs-per-dispatch > 1: K (train+valid) epochs per XLA dispatch.
+
+    Per-epoch metrics and log lines are identical to the per-epoch path
+    (the program returns per-epoch summaries); the trade-off is checkpoint
+    cadence — only the chunk-final state exists on host, so the rolling
+    checkpoint (and any best-model save) happens once per chunk.
+    """
+    import numpy as np
+
+    history = []
+    epoch = start_epoch
+    while epoch < cfg.nb_epochs:
+        chunk = list(range(epoch,
+                           min(epoch + cfg.epochs_per_dispatch,
+                               cfg.nb_epochs)))
+        chunk_start = utils.monotonic()
+        idx_tr, valid_tr = train_loader.epoch_plan_many(chunk)
+        idx_va, valid_va = valid_loader.epoch_plan_many(chunk)
+        keys = jnp.stack([utils.fold_key(root, e) for e in chunk])
+        state, out = engine.train_epochs(
+            state, train_loader.images, train_loader.labels, idx_tr,
+            valid_tr, valid_loader.images, valid_loader.labels, idx_va,
+            valid_va, keys)
+        out = jax.device_get(out)
+        end = utils.monotonic()
+
+        per_epoch_s = (end - chunk_start) / len(chunk)
+        train_samples = len(train_loader) * train_loader.global_batch
+        sps_chip = train_samples / max(per_epoch_s, 1e-9) / world
+        for k, e in enumerate(chunk):
+            train_loss = float(np.mean(out["train_loss"][k]))
+            train_acc = float(out["train_correct"][k]
+                              / max(out["train_valid"][k], 1.0))
+            valid_loss = float(out["eval"]["loss_numer"][k]
+                               / max(out["eval"]["loss_denom"][k], 1e-9))
+            valid_acc = float(out["eval"]["correct"][k]
+                              / max(out["eval"]["valid"][k], 1.0))
+            improved = valid_loss < best_valid_loss
+            if runtime.is_main():
+                print(f"====================== epoch{e + 1:4d} "
+                      f"======================")
+                _progress_logs(e, out["train_loss"][k])
+                epoch_mins, epoch_secs = utils.get_duration(0, per_epoch_s)
+                mins, _ = utils.get_duration(start_time, end)
+                logging.info(
+                    f"{'*' if improved else ' '} Epoch: {e + 1:03}  "
+                    f"| Duration: {epoch_mins:03d}m {epoch_secs:02d}s  "
+                    f"| Overall duration: {mins / 60:.2f}h")
+                logging.info(f"  Train       | Loss: {train_loss:.5f}       "
+                             f"| Acc: {train_acc * 100:.2f}%")
+                logging.info(f"  Validation  | Loss: {valid_loss:.5f}       "
+                             f"| Acc: {valid_acc * 100:.2f}%")
+                logging.info(f"  Throughput  | {sps_chip:,.0f} "
+                             f"samples/s/chip ({world} chip"
+                             f"{'s' if world > 1 else ''})")
+            if improved:
+                best_valid_loss = valid_loss
+            history.append({"epoch": e, "train_loss": train_loss,
+                            "train_acc": train_acc,
+                            "valid_loss": valid_loss,
+                            "valid_acc": valid_acc})
+
+        last = chunk[-1]
+        if runtime.is_main():
+            ckpt.rotate_checkpoint(cfg.rsl_path, cfg.dataset, model_name,
+                                   last)
+            for prev in chunk[:-1]:  # rolling files from earlier chunks
+                ckpt.rotate_checkpoint(cfg.rsl_path, cfg.dataset, model_name,
+                                       prev)
+            ckpt.save_checkpoint(
+                ckpt.checkpoint_path(cfg.rsl_path, cfg.dataset, model_name,
+                                     last),
+                model_name, state, last, best_valid_loss)
+            if history[-1]["valid_loss"] <= best_valid_loss:
+                ckpt.save_checkpoint(
+                    ckpt.best_model_path(cfg.rsl_path, cfg.dataset,
+                                         model_name),
+                    model_name, state, last, best_valid_loss)
+        epoch = last + 1
+    return {"history": history, "best_valid_loss": best_valid_loss,
+            "model_name": model_name}
+
+
 def run_train(cfg: Config) -> dict:
     """ref train() (classif.py:75-192), TPU-native."""
     runtime.initialize_distributed()
@@ -173,6 +260,15 @@ def run_train(cfg: Config) -> dict:
         start_epoch, best_valid_loss = 0, float("inf")
 
     start_time = utils.monotonic()
+    use_chunks = (cfg.epochs_per_dispatch > 1
+                  and isinstance(train_loader, ResidentLoader)
+                  and isinstance(valid_loader, ResidentLoader))
+    if use_chunks:
+        return _run_train_chunked(cfg, engine, state, train_loader,
+                                  valid_loader, model_name, root,
+                                  start_epoch, best_valid_loss, start_time,
+                                  world)
+
     history = []
     for epoch in range(start_epoch, cfg.nb_epochs):
         if runtime.is_main():

@@ -84,6 +84,10 @@ class Config:
     data_mode: str = "auto"
     resident_max_bytes: int = 512 * 1024 * 1024
     profile: bool = False                  # jax.profiler trace of one epoch
+    # Fuse K (train+valid) epochs into one XLA dispatch (resident mode
+    # only).  K>1 amortizes dispatch latency; checkpoints are then written
+    # per chunk instead of per epoch.  1 = exact reference cadence.
+    epochs_per_dispatch: int = 1
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -119,6 +123,11 @@ def _common_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--profile", action="store_true",
                    help="write a jax.profiler trace of the second epoch "
                         "to RSL_PATH/trace")
+    p.add_argument("--epochs-per-dispatch", type=int, default=1,
+                   dest="epochsPerDispatch", metavar="K",
+                   help="fuse K train+valid epochs per XLA dispatch "
+                        "(resident mode; checkpoints then written per "
+                        "chunk; default 1)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -163,4 +172,5 @@ def config_from_argv(argv=None) -> Config:
         half_precision=not args.no_bf16,
         data_mode=args.dataMode,
         profile=args.profile,
+        epochs_per_dispatch=args.epochsPerDispatch,
     )

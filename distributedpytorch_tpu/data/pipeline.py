@@ -76,13 +76,24 @@ class ResidentLoader:
     def global_batch(self) -> int:
         return self.world * self.batch_per_replica
 
-    def epoch_plan(self, epoch: int) -> Tuple[jax.Array, jax.Array]:
-        """(idx, valid) device arrays of shape (steps, global_batch)."""
+    def _host_plan(self, epoch: int) -> Tuple[np.ndarray, np.ndarray]:
         per_rank = [s.epoch_indices(epoch) for s in self.samplers]
         idx = np.concatenate([ix for ix, _ in per_rank], axis=1)
         valid = np.concatenate([v for _, v in per_rank], axis=1)
-        return (_put_global(idx.astype(np.int32), self.plan_sharding),
+        return idx.astype(np.int32), valid
+
+    def epoch_plan(self, epoch: int) -> Tuple[jax.Array, jax.Array]:
+        """(idx, valid) device arrays of shape (steps, global_batch)."""
+        idx, valid = self._host_plan(epoch)
+        return (_put_global(idx, self.plan_sharding),
                 _put_global(valid, self.plan_sharding))
+
+    def epoch_plan_many(self, epochs) -> Tuple[jax.Array, jax.Array]:
+        """Stacked plans (K, steps, global_batch) for multi-epoch dispatch."""
+        plans = [self._host_plan(e) for e in epochs]
+        sharding = NamedSharding(self.mesh, P(None, None, DATA_AXIS))
+        return (_put_global(np.stack([p[0] for p in plans]), sharding),
+                _put_global(np.stack([p[1] for p in plans]), sharding))
 
 
 def _put_global(array: np.ndarray, sharding: NamedSharding) -> jax.Array:

@@ -98,6 +98,7 @@ class Engine:
         # one XLA dispatch per epoch instead of one per step.
         self.train_epoch = jax.jit(self._train_epoch, donate_argnums=0)
         self.eval_epoch = jax.jit(self._eval_epoch)
+        self.train_epochs = jax.jit(self._train_epochs, donate_argnums=0)
 
     # -- state ------------------------------------------------------------
 
@@ -213,6 +214,36 @@ class Engine:
                  for k in ("loss_numer", "loss_denom", "correct", "valid")}
         totals, _ = jax.lax.scan(body, zeros, (idx, valid))
         return totals
+
+    def _train_epochs(self, state: TrainState, images_all, labels_all,
+                      idx_tr, valid_tr, vimages_all, vlabels_all,
+                      idx_va, valid_va, keys
+                      ) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        """K (train pass + validation pass) epochs in ONE dispatch.
+
+        idx_tr/valid_tr: (K, S, B); idx_va/valid_va: (K, Sv, B);
+        keys: (K,) per-epoch PRNG keys.  Returns per-epoch train loss
+        traces (K, S) plus per-epoch train/valid summary scalars — the same
+        quantities the one-epoch-at-a-time driver path computes, so the
+        per-epoch log lines are reproduced exactly.  Used by the
+        --epochs-per-dispatch throughput knob; the trade-off (documented in
+        README) is that only the chunk-final state exists on host, so
+        rolling checkpoints are written per chunk, not per epoch.
+        """
+
+        def epoch_body(st, xs):
+            itr, vtr, iva, vva, key = xs
+            st, m = self._train_epoch(st, images_all, labels_all, itr, vtr,
+                                      key)
+            ev = self._eval_epoch(st, vimages_all, vlabels_all, iva, vva)
+            out = {"train_loss": m["loss"],
+                   "train_correct": jnp.sum(m["correct"]),
+                   "train_valid": jnp.sum(m["valid"]),
+                   "eval": ev}
+            return st, out
+
+        return jax.lax.scan(epoch_body, state,
+                            (idx_tr, valid_tr, idx_va, valid_va, keys))
 
     def _eval_step(self, state: TrainState, images_u8, labels, valid
                    ) -> Dict[str, jax.Array]:
