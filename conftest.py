@@ -9,20 +9,14 @@ This must run before anything initializes a JAX backend: the environment's
 sitecustomize registers a TPU tunnel backend at interpreter startup, and
 ``jax.config.update('jax_platforms', 'cpu')`` re-points selection at the
 host platform, while XLA_FLAGS (read at first backend init) fans it out to
-8 virtual devices.  Set DPT_TESTS_ON_TPU=1 to run the suite on real chips.
+8 virtual devices.  The recipe lives in ``__graft_entry__._force_cpu_devices``
+(shared with the driver's multi-chip dry-run so the two cannot drift).
+Set DPT_TESTS_ON_TPU=1 to run the suite on real chips.
 """
 
 import os
 
 if os.environ.get("DPT_TESTS_ON_TPU") != "1":
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8").strip()
-    import jax
+    from __graft_entry__ import _force_cpu_devices
 
-    jax.config.update("jax_platforms", "cpu")
-    # One synchronous dispatch at a time: with a single host core, queueing
-    # several 8-participant collective programs can starve XLA:CPU's 40s
-    # rendezvous (observed as SIGABRT in rendezvous.cc).
-    jax.config.update("jax_cpu_enable_async_dispatch", False)
+    _force_cpu_devices(8)

@@ -67,11 +67,24 @@ def save_checkpoint(path: str, model_name: str, state: TrainState,
 
 
 def _read(path: str) -> dict:
-    with open(path, "rb") as f:
-        payload = serialization.msgpack_restore(f.read())
-    if payload.get("format_version") != _FORMAT_VERSION:
-        raise ValueError(f"{path}: unsupported checkpoint format "
-                         f"{payload.get('format_version')!r}")
+    """Read + validate a checkpoint; all failure modes surface as ValueError
+    so the CLI can log-and-exit (ref classif.py:119-120 style) instead of
+    tracebacking on a missing or corrupt file."""
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        raise ValueError(f"cannot read checkpoint file {path!r}: "
+                         f"{e.strerror or e}") from e
+    try:
+        payload = serialization.msgpack_restore(blob)
+    except Exception as e:
+        raise ValueError(f"corrupt checkpoint file {path!r}: {e}") from e
+    if not isinstance(payload, dict) \
+            or payload.get("format_version") != _FORMAT_VERSION:
+        raise ValueError(f"{path}: unsupported checkpoint format"
+                         + (f" {payload.get('format_version')!r}"
+                            if isinstance(payload, dict) else ""))
     return payload
 
 

@@ -164,6 +164,7 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
         per_epoch_s = (end - chunk_start) / len(chunk)
         train_samples = len(train_loader) * train_loader.global_batch
         sps_chip = train_samples / max(per_epoch_s, 1e-9) / world
+        chunk_improved = False
         for k, e in enumerate(chunk):
             train_loss = float(np.mean(out["train_loss"][k]))
             train_acc = float(out["train_correct"][k]
@@ -192,6 +193,7 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
                              f"{'s' if world > 1 else ''})")
             if improved:
                 best_valid_loss = valid_loss
+                chunk_improved = True
             history.append({"epoch": e, "train_loss": train_loss,
                             "train_acc": train_acc,
                             "valid_loss": valid_loss,
@@ -208,7 +210,12 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
                 ckpt.checkpoint_path(cfg.rsl_path, cfg.dataset, model_name,
                                      last),
                 model_name, state, last, best_valid_loss)
-            if history[-1]["valid_loss"] <= best_valid_loss:
+            if chunk_improved:
+                # Only the chunk-final state exists on host, so the best
+                # file holds it (an approximation of the true best epoch
+                # inside the chunk) — but it is written whenever ANY epoch
+                # in the chunk improved, keeping the recorded
+                # best_valid_loss and the best-model file in sync.
                 ckpt.save_checkpoint(
                     ckpt.best_model_path(cfg.rsl_path, cfg.dataset,
                                          model_name),
@@ -240,13 +247,29 @@ def run_train(cfg: Config) -> dict:
     else:
         model_name = cfg.model_name
 
+    if cfg.epochs_per_dispatch < 1:
+        raise ValueError(
+            f"--epochs-per-dispatch must be >= 1, got "
+            f"{cfg.epochs_per_dispatch}")
+
     # Data path honored (fixes SURVEY defect #1).
     dataset = load_dataset(cfg.dataset, cfg.data_path, cfg.seed,
-                           debug=cfg.debug, log=runtime.is_main())
+                           debug=cfg.debug, log=runtime.is_main(),
+                           synthetic_fallback=cfg.synthetic_fallback)
     train_loader = _make_loader(cfg, dataset.splits["train"], mesh,
                                 shuffle=True)
     valid_loader = _make_loader(cfg, dataset.splits["valid"], mesh,
                                 shuffle=True)
+
+    use_chunks = (cfg.epochs_per_dispatch > 1
+                  and isinstance(train_loader, ResidentLoader)
+                  and isinstance(valid_loader, ResidentLoader))
+    if cfg.epochs_per_dispatch > 1 and not use_chunks:
+        raise ValueError(
+            "--epochs-per-dispatch > 1 requires device-resident data "
+            "(whole epochs are fused into one XLA program); this run is "
+            "streaming — drop --data-mode stream or lower the corpus size "
+            "below --resident-max-bytes")
 
     engine = _build_engine(cfg, model_name, dataset, len(train_loader))
     root = utils.root_key(cfg.seed)
@@ -260,9 +283,6 @@ def run_train(cfg: Config) -> dict:
         start_epoch, best_valid_loss = 0, float("inf")
 
     start_time = utils.monotonic()
-    use_chunks = (cfg.epochs_per_dispatch > 1
-                  and isinstance(train_loader, ResidentLoader)
-                  and isinstance(valid_loader, ResidentLoader))
     if use_chunks:
         return _run_train_chunked(cfg, engine, state, train_loader,
                                   valid_loader, model_name, root,
@@ -300,8 +320,13 @@ def run_train(cfg: Config) -> dict:
         train_samples = len(train_loader) * train_loader.global_batch
         sps_chip = train_samples / max(train_end - epoch_start, 1e-9) / world
 
+        # Update best BEFORE any checkpoint write so the rolling file
+        # carries the post-epoch best; saving it first would make a resume
+        # from an improving epoch restore a stale best_valid_loss.
+        improved = valid_loss < best_valid_loss
+        if improved:
+            best_valid_loss = valid_loss
         if runtime.is_main():  # ref classif.py:176-192
-            improved = valid_loss < best_valid_loss
             logging.info(
                 f"{'*' if improved else ' '} Epoch: {epoch + 1:03}  "
                 f"| Duration: {epoch_mins:03d}m {epoch_secs:02d}s  "
@@ -320,13 +345,10 @@ def run_train(cfg: Config) -> dict:
                                      epoch),
                 model_name, state, epoch, best_valid_loss)
             if improved:
-                best_valid_loss = valid_loss
                 ckpt.save_checkpoint(
                     ckpt.best_model_path(cfg.rsl_path, cfg.dataset,
                                          model_name),
                     model_name, state, epoch, best_valid_loss)
-        else:
-            best_valid_loss = min(best_valid_loss, valid_loss)
         history.append({"epoch": epoch, "train_loss": train_loss,
                         "train_acc": train_acc, "valid_loss": valid_loss,
                         "valid_acc": valid_acc})
@@ -347,7 +369,8 @@ def run_test(cfg: Config) -> dict:
 
     model_name = ckpt.get_checkpoint_model_name(cfg.checkpoint_file)
     dataset = load_dataset(cfg.dataset, cfg.data_path, cfg.seed,
-                           debug=cfg.debug, log=runtime.is_main())
+                           debug=cfg.debug, log=runtime.is_main(),
+                           synthetic_fallback=cfg.synthetic_fallback)
     test_loader = _make_loader(cfg, dataset.splits["test"], mesh,
                                shuffle=True)
 

@@ -82,6 +82,9 @@ class Config:
     # 'resident': split lives in HBM, one XLA dispatch per epoch;
     # 'stream': host batching + prefetch; 'auto' picks by size.
     data_mode: str = "auto"
+    # Opt-in: train on the deterministic synthetic corpus when the real
+    # dataset's raw files are absent (otherwise that is a CLI error).
+    synthetic_fallback: bool = False
     resident_max_bytes: int = 512 * 1024 * 1024
     profile: bool = False                  # jax.profiler trace of one epoch
     # Fuse K (train+valid) epochs into one XLA dispatch (resident mode
@@ -120,6 +123,11 @@ def _common_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--data-mode", choices=("auto", "stream", "resident"),
                    default="auto", dest="dataMode",
                    help="device-resident vs streamed batches (default: auto)")
+    p.add_argument("--synthetic-fallback", action="store_true",
+                   dest="syntheticFallback",
+                   help="use the deterministic synthetic corpus when the "
+                        "real dataset's raw files are absent (default: "
+                        "error out)")
     p.add_argument("--profile", action="store_true",
                    help="write a jax.profiler trace of the second epoch "
                         "to RSL_PATH/trace")
@@ -171,6 +179,7 @@ def config_from_argv(argv=None) -> Config:
         debug=args.debug,
         half_precision=not args.no_bf16,
         data_mode=args.dataMode,
+        synthetic_fallback=args.syntheticFallback,
         profile=args.profile,
         epochs_per_dispatch=args.epochsPerDispatch,
     )

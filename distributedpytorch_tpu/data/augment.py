@@ -22,6 +22,10 @@ Parity notes vs torchvision:
     10-attempt rejection loop + center-crop fallback (rejection is
     jit-hostile; the sampled distributions differ only in rare tail cases).
   * Rotation angle ~ U(-5°,5°), fill 0, about the image center — same.
+  * Interpolation: torchvision RandomRotation defaults to NEAREST and the
+    crop resize is bilinear; the fused warp is bilinear end-to-end, a
+    per-pixel numeric divergence from the reference train transform
+    (deliberate: one exact bilinear pass, better quality, MXU-friendly).
   * All randomness flows from a single JAX key: per-image keys are derived
     with fold_in, so results are independent of batch size and device count.
 """
@@ -29,13 +33,16 @@ Parity notes vs torchvision:
 from __future__ import annotations
 
 import functools
+import math
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 
 SCALE_RANGE = (0.08, 1.0)        # torchvision RandomResizedCrop defaults
-LOG_RATIO_RANGE = (jnp.log(3.0 / 4.0), jnp.log(4.0 / 3.0))
+# math.log, not jnp.log: module-level jnp would initialize a JAX backend at
+# import time, which breaks hosts that must pick the platform *after* import.
+LOG_RATIO_RANGE = (math.log(3.0 / 4.0), math.log(4.0 / 3.0))
 MAX_ROTATION_DEG = 5.0           # ref dataloader.py:102
 
 

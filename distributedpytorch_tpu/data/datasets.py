@@ -65,8 +65,10 @@ class Dataset:
 
 
 def load_dataset(name: str, data_path: str, seed: int,
-                 debug: bool = False, log: bool = False) -> Dataset:
-    tr_x, tr_y, te_x, te_y = io.load_raw(name, data_path)
+                 debug: bool = False, log: bool = False,
+                 synthetic_fallback: bool = False) -> Dataset:
+    tr_x, tr_y, te_x, te_y = io.load_raw(name, data_path,
+                                         synthetic_fallback)
 
     # Normalization stats from raw train pixels (ref dataloader.py:92-96).
     mean = float(tr_x.astype(np.float32).mean() / 255.0)

@@ -131,12 +131,14 @@ def make_synthetic(num_train: int = 60000, num_test: int = 10000,
     return tr_x, tr_y, te_x, te_y
 
 
-def load_raw(dataset: str, data_path: str):
-    """Dispatch by dataset name, with synthetic fallback.
+def load_raw(dataset: str, data_path: str, synthetic_fallback: bool = False):
+    """Dispatch by dataset name.
 
-    Falls back to the synthetic corpus (with a loud warning) when the raw
-    files are absent, so the north-star command `main.py train -d PATH` runs
-    on any machine; accuracy numbers are only meaningful on real data.
+    A real dataset whose raw files are absent is an error (surfaced as
+    ValueError so the CLI log-and-exits, ref classif.py:119-120 style) —
+    unless ``synthetic_fallback`` opts into the deterministic synthetic
+    corpus (with a loud warning); accuracy numbers are then meaningless for
+    the real dataset.
     """
     try:
         if dataset == "mnist":
@@ -146,6 +148,11 @@ def load_raw(dataset: str, data_path: str):
         if dataset == "cifar10":
             return load_cifar10(data_path)
     except FileNotFoundError as e:
+        if not synthetic_fallback:
+            raise ValueError(
+                f"{dataset} raw files not found under {data_path!r} ({e}); "
+                "pass --synthetic-fallback to train on the synthetic corpus "
+                "instead") from e
         logging.warning(f"{dataset} raw files not found ({e}); "
                         "FALLING BACK TO SYNTHETIC DATA — accuracy numbers "
                         "will not reflect the real dataset")

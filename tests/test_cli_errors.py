@@ -1,0 +1,64 @@
+"""CLI error surface: user mistakes must log-and-exit (rc 1), never
+traceback — the reference's error style (ref classif.py:119-120,130-131,
+utils.py:102-103).
+"""
+
+import pytest
+
+from distributedpytorch_tpu.cli import main
+from distributedpytorch_tpu.config import Config, config_from_argv
+
+
+def _argv(tmp_path, *extra):
+    return ["train", "-d", str(tmp_path / "nodata"),
+            "--rsl_path", str(tmp_path / "rsl"), "--debug", *extra]
+
+
+def test_missing_checkpoint_file_exits_cleanly(tmp_path):
+    rc = main(["test", "-d", str(tmp_path), "--rsl_path", str(tmp_path),
+               "--dataset", "synthetic", "--debug",
+               "-f", str(tmp_path / "does-not-exist.ckpt")])
+    assert rc == 1
+
+
+def test_corrupt_checkpoint_file_exits_cleanly(tmp_path):
+    bad = tmp_path / "corrupt.ckpt"
+    bad.write_bytes(b"\x00\x01not a msgpack checkpoint\xff")
+    rc = main(["test", "-d", str(tmp_path), "--rsl_path", str(tmp_path),
+               "--dataset", "synthetic", "--debug", "-f", str(bad)])
+    assert rc == 1
+
+
+def test_missing_real_dataset_exits_cleanly(tmp_path):
+    """--dataset cifar10 with no raw files is an error, not a silent
+    synthetic fallback."""
+    rc = main(_argv(tmp_path, "--dataset", "cifar10", "--model", "mlp",
+                    "-e", "1"))
+    assert rc == 1
+
+
+def test_synthetic_fallback_flag_opts_in(tmp_path):
+    """The old always-fallback behavior survives behind an explicit flag."""
+    rc = main(_argv(tmp_path, "--dataset", "mnist", "--model", "mlp",
+                    "-e", "1", "-b", "8", "--synthetic-fallback",
+                    "--no-bf16"))
+    assert rc == 0
+
+
+def test_epochs_per_dispatch_stream_conflict_exits_cleanly(tmp_path):
+    rc = main(_argv(tmp_path, "--dataset", "synthetic", "--model", "mlp",
+                    "-e", "2", "--data-mode", "stream",
+                    "--epochs-per-dispatch", "2"))
+    assert rc == 1
+
+
+def test_epochs_per_dispatch_below_one_exits_cleanly(tmp_path):
+    rc = main(_argv(tmp_path, "--dataset", "synthetic", "--model", "mlp",
+                    "-e", "1", "--epochs-per-dispatch", "0"))
+    assert rc == 1
+
+
+def test_config_carries_fallback_flag():
+    cfg = config_from_argv(["train", "-d", "/x", "--synthetic-fallback"])
+    assert cfg.synthetic_fallback
+    assert not config_from_argv(["train", "-d", "/x"]).synthetic_fallback
