@@ -12,8 +12,9 @@ logging — re-architected idiomatically for TPU:
     ``jax.process_index``), not a hand-edited IP table
     (ref: main.py:60-110);
   * the DDP wrapper's hidden gradient allreduce (ref: classif.py:138)
-    becomes an explicit ``jax.lax.pmean`` over a named mesh axis inside a
-    jit-compiled SPMD train step;
+    becomes a compiler-inserted all-reduce: the train step is jit-compiled
+    over batches sharded along the mesh's 'data' axis, and XLA places the
+    gradient reduction exactly where DDP's hidden one was;
   * ``DistributedSampler`` (ref: dataloader.py:147-152) becomes a
     deterministic, epoch-keyed global permutation sharded by process index;
   * data augmentation runs *on device* as a single fused affine warp inside
@@ -27,7 +28,9 @@ Layer map (mirrors SURVEY.md §1):
   L3  engine          distributedpytorch_tpu.train, .ops
   L4  launcher/CLI    distributedpytorch_tpu.cli  (entry: main.py)
   --  models          distributedpytorch_tpu.models
-  --  parallelism     distributedpytorch_tpu.parallel
+  --  parallelism     distributedpytorch_tpu.parallel  (model-axis param/
+                      optimizer sharding over the 2-D mesh; data
+                      parallelism itself lives in the engine + runtime)
 """
 
 __version__ = "0.1.0"

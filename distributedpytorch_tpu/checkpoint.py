@@ -29,10 +29,32 @@ from typing import Optional, Tuple
 import jax
 import numpy as np
 from flax import serialization
+from jax.sharding import NamedSharding, PartitionSpec
 
 from .train.engine import TrainState
 
 _FORMAT_VERSION = 1
+
+
+def _gather_replicated(state: TrainState) -> TrainState:
+    """Make every array fully replicated before host transfer.
+
+    With --model-parallel, params/opt-state live sharded over the 'model'
+    mesh axis; on multi-host meshes ``jax.device_get`` of such arrays would
+    fail (non-addressable shards).  A jitted identity with replicated
+    out_shardings performs the all-gather as an XLA program, which is
+    multi-host-safe.  No-op (and no dispatch) for the default replicated
+    layout.
+    """
+    leaves = [a for a in jax.tree_util.tree_leaves(state)
+              if isinstance(a, jax.Array)]
+    if all(getattr(a, "is_fully_replicated", True) for a in leaves):
+        return state
+    mesh = next(a.sharding.mesh for a in leaves
+                if isinstance(a.sharding, NamedSharding))
+    replicated = NamedSharding(mesh, PartitionSpec())
+    shardings = jax.tree_util.tree_map(lambda _: replicated, state)
+    return jax.jit(lambda x: x, out_shardings=shardings)(state)
 
 
 def checkpoint_path(rsl_path: str, dataset: str, model_name: str,
@@ -55,7 +77,8 @@ def save_checkpoint(path: str, model_name: str, state: TrainState,
         "model_name": model_name,
         "epoch": int(epoch),
         "loss": float(best_valid_loss),
-        "state": serialization.to_state_dict(jax.device_get(state)),
+        "state": serialization.to_state_dict(
+            jax.device_get(_gather_replicated(state))),
     }
     blob = serialization.msgpack_serialize(payload)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -95,7 +118,7 @@ def load_checkpoint(path: str, state: TrainState,
     best_valid_loss).  ``state`` is a template with the right structure
     (fresh Engine.init_state output); restored arrays replace its leaves."""
     payload = _read(path)
-    template = jax.device_get(state)
+    template = jax.device_get(_gather_replicated(state))
     if not restore_optimizer:  # test path passes optimizer=None (ref :232)
         payload["state"]["opt_state"] = serialization.to_state_dict(
             template).get("opt_state", {})

@@ -17,12 +17,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import checkpoint as ckpt
-from . import runtime, utils
+from . import parallel, runtime, utils
 from .config import Config, config_from_argv
 from .data import augment  # noqa: F401  (re-exported for drivers/tests)
 from .data.datasets import Dataset, Split, load_dataset
 from .data.pipeline import ResidentLoader, ShardedLoader
-from .models import get_model, get_model_input_size
+from .models import get_model, get_model_input_size, pretrained
 from .ops.losses import get_loss_fn
 from .train.engine import Engine, make_optimizer
 
@@ -44,7 +44,11 @@ def _build_engine(cfg: Config, model_name: str, dataset: Dataset,
                   half_precision=cfg.half_precision)
 
 
-def _replicate(state, mesh):
+def _place_state(state, mesh, cfg: Config):
+    """Replicated (reference semantics) or model-axis-sharded placement
+    (--model-parallel > 1; see parallel.py)."""
+    if cfg.model_parallel > 1:
+        return jax.device_put(state, parallel.state_sharding(state, mesh))
     return jax.device_put(state, runtime.replicated_sharding(mesh))
 
 
@@ -230,7 +234,7 @@ def run_train(cfg: Config) -> dict:
     runtime.initialize_distributed()
     utils.initialize_logging(cfg.rsl_path, cfg.log_file,
                              truncate=runtime.is_main())
-    mesh = runtime.make_mesh()
+    mesh = runtime.make_mesh(model_parallel=cfg.model_parallel)
     world = runtime.world_size()
     if runtime.is_main():
         logging.info(f"process: {runtime.process_index()}/"
@@ -251,6 +255,10 @@ def run_train(cfg: Config) -> dict:
         raise ValueError(
             f"--epochs-per-dispatch must be >= 1, got "
             f"{cfg.epochs_per_dispatch}")
+    if cfg.use_pretrained and not cfg.checkpoint_file:
+        # Fail unsupported-arch / missing-path mistakes here, before the
+        # dataset load and model init pay for a doomed run.
+        pretrained.validate_request(model_name, cfg.pretrained_path)
 
     # Data path honored (fixes SURVEY defect #1).
     dataset = load_dataset(cfg.dataset, cfg.data_path, cfg.seed,
@@ -273,13 +281,27 @@ def run_train(cfg: Config) -> dict:
 
     engine = _build_engine(cfg, model_name, dataset, len(train_loader))
     root = utils.root_key(cfg.seed)
-    state = _replicate(engine.init_state(root, dataset.channels), mesh)
+    state = engine.init_state(root, dataset.channels)
 
     if cfg.checkpoint_file:
+        # load into the host-side template, then place once
         state, start_epoch, best_valid_loss = ckpt.load_checkpoint(
             cfg.checkpoint_file, state)
-        state = _replicate(state, mesh)
+        state = _place_state(state, mesh, cfg)
     else:
+        if cfg.use_pretrained:
+            # Backbone from a user-provided torchvision state_dict, fresh
+            # head — the reference's replace-head-after-load fine-tuning
+            # init (ref utils.py:38-105, config.py:51).  Raises for
+            # unsupported archs or a missing file; never a silent no-op.
+            params, batch_stats = pretrained.load_pretrained(
+                model_name, cfg.pretrained_path, state.params,
+                state.batch_stats)
+            state = state.replace(params=params, batch_stats=batch_stats)
+            if runtime.is_main():
+                logging.info(f"pretrained backbone loaded from "
+                             f"{cfg.pretrained_path}")
+        state = _place_state(state, mesh, cfg)
         start_epoch, best_valid_loss = 0, float("inf")
 
     start_time = utils.monotonic()
@@ -361,7 +383,7 @@ def run_test(cfg: Config) -> dict:
     runtime.initialize_distributed()
     utils.initialize_logging(cfg.rsl_path, cfg.log_file,
                              truncate=runtime.is_main())
-    mesh = runtime.make_mesh()
+    mesh = runtime.make_mesh(model_parallel=cfg.model_parallel)
     if runtime.is_main():
         logging.info(f"process: {runtime.process_index()}/"
                      f"{runtime.process_count()}, world size: "
@@ -375,11 +397,12 @@ def run_test(cfg: Config) -> dict:
                                shuffle=True)
 
     engine = _build_engine(cfg, model_name, dataset, len(test_loader))
-    state = _replicate(
-        engine.init_state(utils.root_key(cfg.seed), dataset.channels), mesh)
-    state, _, _ = ckpt.load_checkpoint(cfg.checkpoint_file, state,
-                                       restore_optimizer=False)
-    state = _replicate(state, mesh)
+    # load into the host-side template, then place once
+    state, _, _ = ckpt.load_checkpoint(
+        cfg.checkpoint_file,
+        engine.init_state(utils.root_key(cfg.seed), dataset.channels),
+        restore_optimizer=False)
+    state = _place_state(state, mesh, cfg)
 
     start_time = utils.monotonic()
     loss, acc = _run_eval_pass(engine, state, test_loader, epoch=0)

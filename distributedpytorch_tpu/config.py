@@ -18,7 +18,9 @@ Deliberate divergences (documented in README):
     the DATA_PATH constant — SURVEY defect #1, ref classif.py:98,217).
   * The DDTNodes address table / MASTER_ADDR / MASTER_PORT (ref config.py:15-24)
     have no equivalent: TPU topology is discovered from the runtime.
-  * NUM_WORKERS / NUM_THREADS become prefetch depth / host thread knobs.
+  * NUM_WORKERS becomes device prefetch depth.  NUM_THREADS (ref
+    config.py:54, torch.set_num_threads on the CPU fallback) is obviated:
+    XLA manages its own host thread pools.
 """
 
 from __future__ import annotations
@@ -74,6 +76,9 @@ class Config:
     seed: int = SEED
     feature_extract: bool = FEATURE_EXTRACT
     use_pretrained: bool = USE_PRETRAINED
+    # Torch state_dict (.pth) to initialize the backbone from; required when
+    # use_pretrained=True (no network access — weights are never downloaded).
+    pretrained_path: Optional[str] = None
     checkpoint_file: Optional[str] = None  # -f: resume (train) / model (test)
     debug: bool = DEBUG                    # 200-sample subset, ref dataloader.py:139-144
     prefetch: int = NUM_WORKERS            # device prefetch depth
@@ -91,6 +96,10 @@ class Config:
     # only).  K>1 amortizes dispatch latency; checkpoints are then written
     # per chunk instead of per epoch.  1 = exact reference cadence.
     epochs_per_dispatch: int = 1
+    # Fold the devices into a 2-D (data, model) mesh and shard large
+    # param/optimizer tensors over the 'model' axis (ZeRO/FSDP-style,
+    # see parallel.py).  1 = pure data parallelism (reference semantics).
+    model_parallel: int = 1
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -123,6 +132,18 @@ def _common_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--data-mode", choices=("auto", "stream", "resident"),
                    default="auto", dest="dataMode",
                    help="device-resident vs streamed batches (default: auto)")
+    p.add_argument("--feature-extract", action="store_true",
+                   dest="featureExtract", default=FEATURE_EXTRACT,
+                   help="freeze the backbone, train only the classifier "
+                        "head (ref FEATURE_EXTRACT)")
+    p.add_argument("--use-pretrained", action="store_true",
+                   dest="usePretrained", default=USE_PRETRAINED,
+                   help="initialize the backbone from --pretrained-path "
+                        "(a torchvision state_dict; ref USE_PRETRAINED)")
+    p.add_argument("--pretrained-path", type=str, default=None,
+                   dest="pretrainedPath", metavar="FILE",
+                   help="torch .pth state_dict for --use-pretrained "
+                        "(never downloaded)")
     p.add_argument("--synthetic-fallback", action="store_true",
                    dest="syntheticFallback",
                    help="use the deterministic synthetic corpus when the "
@@ -136,6 +157,11 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                    help="fuse K train+valid epochs per XLA dispatch "
                         "(resident mode; checkpoints then written per "
                         "chunk; default 1)")
+    p.add_argument("--model-parallel", type=int, default=1,
+                   dest="modelParallel", metavar="N",
+                   help="shard large param/optimizer tensors over an "
+                        "N-way 'model' mesh axis (must divide the device "
+                        "count; default 1 = replicated)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -175,6 +201,9 @@ def config_from_argv(argv=None) -> Config:
         loss=args.loss,
         batch_size=args.batchSize,
         nb_epochs=getattr(args, "nbEpochs", NB_EPOCHS),
+        feature_extract=args.featureExtract,
+        use_pretrained=args.usePretrained,
+        pretrained_path=args.pretrainedPath,
         checkpoint_file=args.checkpointFile,
         debug=args.debug,
         half_precision=not args.no_bf16,
@@ -182,4 +211,5 @@ def config_from_argv(argv=None) -> Config:
         synthetic_fallback=args.syntheticFallback,
         profile=args.profile,
         epochs_per_dispatch=args.epochsPerDispatch,
+        model_parallel=args.modelParallel,
     )

@@ -7,12 +7,20 @@ classifier uniformly named ``head`` — which makes the reference's
 ``feature_extract`` backbone-freezing (ref utils.py:107-110) a one-line
 optax mask instead of a requires_grad walk (see registry.trainable_mask).
 
-BatchNorm uses per-replica statistics — deliberately matching DDP, which
-does not synchronize BN across ranks (SURVEY §7 step 4 decision point).
+BatchNorm statistics are GLOBAL (sync-BN semantics): the train step is one
+jit program over the globally-sharded batch, so batch stats are computed
+over the global batch — a deliberate divergence from DDP's per-replica BN
+(SURVEY §7 step 4 decision point).  It is also what makes the
+sharded == single-device-big-batch equivalence in tests/test_distributed.py
+hold exactly for BN models.
+
+``pretrained`` converts user-provided torchvision state_dicts into these
+modules' param trees (ref use_pretrained, utils.py:38-105).
 """
 
+from . import pretrained, registry
 from .registry import (get_model, get_model_input_size, head_mask_label,
                        trainable_mask, MODEL_REGISTRY)
 
 __all__ = ["get_model", "get_model_input_size", "head_mask_label",
-           "trainable_mask", "MODEL_REGISTRY"]
+           "trainable_mask", "MODEL_REGISTRY", "pretrained", "registry"]

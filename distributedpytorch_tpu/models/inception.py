@@ -144,6 +144,16 @@ class AuxHead(nn.Module):
 
     @nn.compact
     def __call__(self, x, train):
+        if x.shape[1] < 17 or x.shape[2] < 17:
+            # Below 17x17 the 5x5-VALID conv after the pool receives an
+            # empty tensor and XLA silently yields NaN logits (torchvision's
+            # InceptionAux has the same floor and errors; ref utils.py:89
+            # "expects (299,299) sized images").  Fail at trace time with an
+            # actionable message instead.
+            raise ValueError(
+                f"inception aux head needs a >=17x17 feature map, which "
+                f"requires >=299px inputs; got a {x.shape[1]}x{x.shape[2]} "
+                f"map — use 299x299 inputs for train mode")
         x = nn.avg_pool(x, (5, 5), strides=(3, 3))
         x = BasicConv(128, (1, 1), dtype=self.dtype)(x, train)
         x = BasicConv(768, (5, 5), dtype=self.dtype)(x, train)

@@ -1,0 +1,212 @@
+"""Pretrained-weight loading: torch state_dicts -> Flax params.
+
+The reference's fine-tuning story is ``use_pretrained=True``: every factory
+in ref utils.py:38-105 loads torchvision ImageNet weights, then replaces
+the classifier head (ref utils.py:42-49 for resnet18), optionally freezing
+the backbone (``feature_extract``, ref utils.py:107-110, config.py:48-51).
+
+TPU-native equivalent: convert a torchvision ``state_dict`` (a ``.pth``
+file the user provides — this framework never downloads) into the Flax
+param/batch_stats trees, leaving the freshly-initialized ``head`` in place
+(exactly the reference's replace-after-load semantics).  Conversion rules:
+
+  * torch conv weight (O,I,kH,kW)  -> flax kernel (kH,kW,I,O)
+  * torch linear weight (O,I)      -> flax kernel (I,O)
+  * the FIRST linear after a flatten additionally permutes its input axis
+    from torch's NCHW flatten order (C,H,W) to NHWC flatten order (H,W,C)
+  * BatchNorm weight/bias          -> scale/bias (params)
+    running_mean/running_var       -> mean/var  (batch_stats)
+
+Supported: resnet18, alexnet, vgg11_bn.  Unsupported architectures RAISE —
+``use_pretrained=True`` must never silently no-op.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+SUPPORTED = ("resnet", "alexnet", "vgg")
+
+
+def _t_conv(w) -> np.ndarray:
+    return np.asarray(w, np.float32).transpose(2, 3, 1, 0)
+
+
+def _t_linear(w, spatial: Optional[Tuple[int, int, int]] = None) -> np.ndarray:
+    """(O,I) -> (I,O); with ``spatial=(C,H,W)`` also permute the input axis
+    from CHW-flatten order to HWC-flatten order."""
+    w = np.asarray(w, np.float32)
+    if spatial is not None:
+        c, h, wd = spatial
+        w = w.reshape(-1, c, h, wd).transpose(0, 2, 3, 1).reshape(w.shape[0], -1)
+    return w.T
+
+
+def _vec(v) -> np.ndarray:
+    return np.asarray(v, np.float32)
+
+
+def _bn(sd: Dict[str, Any], prefix: str):
+    """(params {scale,bias}, stats {mean,var}) for one torch BN layer."""
+    return (
+        {"scale": _vec(sd[f"{prefix}.weight"]),
+         "bias": _vec(sd[f"{prefix}.bias"])},
+        {"mean": _vec(sd[f"{prefix}.running_mean"]),
+         "var": _vec(sd[f"{prefix}.running_var"])},
+    )
+
+
+def _convert_resnet18(sd: Dict[str, Any]):
+    """torchvision resnet18 state_dict -> (params, batch_stats), no head."""
+    params: Dict[str, Any] = {"Conv_0": {"kernel": _t_conv(sd["conv1.weight"])}}
+    stats: Dict[str, Any] = {}
+    params["BatchNorm_0"], stats["BatchNorm_0"] = _bn(sd, "bn1")
+    # torchvision layer{1..4}.{0,1} -> BasicBlock_{0..7}; downsample
+    # projections exist at layer{2,3,4}.0 and are our Conv_2/BatchNorm_2.
+    for layer in range(1, 5):
+        for block in range(2):
+            i = (layer - 1) * 2 + block
+            t = f"layer{layer}.{block}"
+            b_params: Dict[str, Any] = {
+                "Conv_0": {"kernel": _t_conv(sd[f"{t}.conv1.weight"])},
+                "Conv_1": {"kernel": _t_conv(sd[f"{t}.conv2.weight"])},
+            }
+            b_stats: Dict[str, Any] = {}
+            b_params["BatchNorm_0"], b_stats["BatchNorm_0"] = _bn(sd, f"{t}.bn1")
+            b_params["BatchNorm_1"], b_stats["BatchNorm_1"] = _bn(sd, f"{t}.bn2")
+            if f"{t}.downsample.0.weight" in sd:
+                b_params["Conv_2"] = {
+                    "kernel": _t_conv(sd[f"{t}.downsample.0.weight"])}
+                b_params["BatchNorm_2"], b_stats["BatchNorm_2"] = _bn(
+                    sd, f"{t}.downsample.1")
+            params[f"BasicBlock_{i}"] = b_params
+            stats[f"BasicBlock_{i}"] = b_stats
+    return params, stats
+
+
+def _convert_alexnet(sd: Dict[str, Any]):
+    """torchvision alexnet: features.{0,3,6,8,10} convs,
+    classifier.{1,4} linears (classifier.6 is the replaced head)."""
+    params: Dict[str, Any] = {}
+    for i, t in enumerate((0, 3, 6, 8, 10)):
+        params[f"Conv_{i}"] = {
+            "kernel": _t_conv(sd[f"features.{t}.weight"]),
+            "bias": _vec(sd[f"features.{t}.bias"])}
+    params["Dense_0"] = {
+        "kernel": _t_linear(sd["classifier.1.weight"], spatial=(256, 6, 6)),
+        "bias": _vec(sd["classifier.1.bias"])}
+    params["Dense_1"] = {"kernel": _t_linear(sd["classifier.4.weight"]),
+                         "bias": _vec(sd["classifier.4.bias"])}
+    return params, {}
+
+
+def _convert_vgg11_bn(sd: Dict[str, Any]):
+    """torchvision vgg11_bn: features conv/BN pairs at
+    (0,1),(4,5),(8,9),(11,12),(15,16),(18,19),(22,23),(25,26);
+    classifier.{0,3} linears (classifier.6 is the replaced head)."""
+    pairs = ((0, 1), (4, 5), (8, 9), (11, 12), (15, 16), (18, 19),
+             (22, 23), (25, 26))
+    params: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+    for i, (c, b) in enumerate(pairs):
+        params[f"Conv_{i}"] = {
+            "kernel": _t_conv(sd[f"features.{c}.weight"]),
+            "bias": _vec(sd[f"features.{c}.bias"])}
+        params[f"BatchNorm_{i}"], stats[f"BatchNorm_{i}"] = _bn(
+            sd, f"features.{b}")
+    params["Dense_0"] = {
+        "kernel": _t_linear(sd["classifier.0.weight"], spatial=(512, 7, 7)),
+        "bias": _vec(sd["classifier.0.bias"])}
+    params["Dense_1"] = {"kernel": _t_linear(sd["classifier.3.weight"]),
+                         "bias": _vec(sd["classifier.3.bias"])}
+    return params, stats
+
+
+_CONVERTERS = {
+    "resnet": _convert_resnet18,
+    "alexnet": _convert_alexnet,
+    "vgg": _convert_vgg11_bn,
+}
+
+
+def convert_state_dict(model_name: str, sd: Dict[str, Any],
+                       params: Any, batch_stats: Any):
+    """Merge a torch state_dict into fresh Flax trees.
+
+    Backbone leaves are replaced by the converted torch weights; the
+    ``head`` (and any other key the converter does not produce) keeps its
+    fresh initialization — the reference's replace-head-after-load
+    semantics (ref utils.py:46-48).  Shapes are validated leaf-by-leaf.
+    """
+    if model_name not in _CONVERTERS:
+        raise ValueError(
+            f"use_pretrained is not supported for {model_name!r} "
+            f"(supported: {', '.join(SUPPORTED)})")
+    sd = {k[len("module."):] if k.startswith("module.") else k: v
+          for k, v in sd.items()}
+    try:
+        conv_params, conv_stats = _CONVERTERS[model_name](sd)
+    except KeyError as e:
+        raise ValueError(
+            f"state_dict is missing key {e.args[0]!r} — is this really a "
+            f"torchvision {model_name} state_dict?") from e
+
+    def merge(fresh, converted, path=""):
+        out = dict(fresh)
+        for k, v in converted.items():
+            if k not in fresh:
+                raise ValueError(f"converted key {path}/{k} not in model")
+            if isinstance(v, dict):
+                out[k] = merge(fresh[k], v, f"{path}/{k}")
+            else:
+                if tuple(np.shape(fresh[k])) != tuple(v.shape):
+                    raise ValueError(
+                        f"shape mismatch at {path}/{k}: model "
+                        f"{tuple(np.shape(fresh[k]))} vs weights {v.shape}")
+                out[k] = v
+        return out
+
+    return merge(params, conv_params), merge(batch_stats, conv_stats)
+
+
+def validate_request(model_name: str, path: Optional[str]) -> None:
+    """Cheap use_pretrained precondition check — callable before any data
+    or model work so user mistakes fail in milliseconds."""
+    if model_name not in _CONVERTERS:
+        raise ValueError(
+            f"use_pretrained is not supported for {model_name!r} "
+            f"(supported: {', '.join(SUPPORTED)})")
+    if not path:
+        raise ValueError(
+            "use_pretrained requires --pretrained-path FILE (a torchvision "
+            f"{model_name} state_dict saved with torch.save); this "
+            "framework never downloads weights")
+
+
+def load_pretrained(model_name: str, path: Optional[str],
+                    params: Any, batch_stats: Any):
+    """Load a user-provided ``.pth``/``.pt`` torch checkpoint and convert.
+
+    Accepts a bare state_dict or a dict with a ``state_dict`` field.  A
+    missing path raises — this framework has no network access and never
+    downloads weights (the torchvision download that ref utils.py relies
+    on is replaced by an explicit file contract, documented in README).
+    """
+    validate_request(model_name, path)
+    try:
+        import torch
+    except ImportError as e:
+        raise ValueError(
+            "use_pretrained needs the 'torch' package to read the .pth "
+            "state_dict (pip install torch, CPU build is enough)") from e
+
+    try:
+        obj = torch.load(path, map_location="cpu", weights_only=True)
+    except Exception as e:
+        raise ValueError(f"cannot load pretrained weights {path!r}: {e}") \
+            from e
+    sd = obj.get("state_dict", obj) if isinstance(obj, dict) else obj
+    sd = {k: v.numpy() if hasattr(v, "numpy") else v for k, v in sd.items()}
+    return convert_state_dict(model_name, sd, params, batch_stats)

@@ -4,7 +4,9 @@ Architecture parity with torchvision resnet18: 7x7/2 stem + 3x3/2 maxpool,
 four stages of two BasicBlocks at widths (64,128,256,512), stride-2
 downsampling with 1x1 projection at each stage entry, global average pool,
 dense ``head`` (the layer the reference replaces, ref utils.py:47-48).
-NHWC, BN with per-replica stats (DDP parity — no cross-replica sync).
+NHWC; BN stats are global under SPMD (the jit step sees the globally-
+sharded batch — sync-BN semantics, a documented divergence from DDP's
+per-replica BN).
 """
 
 from __future__ import annotations
@@ -27,10 +29,13 @@ class BasicBlock(nn.Module):
                                  momentum=0.9, dtype=self.dtype)
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
         residual = x
+        # Explicit symmetric (1,1) padding, not "SAME": with stride 2 XLA's
+        # SAME pads (0,1) while torch pads (1,1) — same output shape,
+        # different alignment — and pretrained-weight parity needs torch's.
         y = conv(self.filters, (3, 3), strides=(self.stride, self.stride),
-                 padding="SAME")(x)
+                 padding=[(1, 1), (1, 1)])(x)
         y = nn.relu(norm()(y))
-        y = conv(self.filters, (3, 3), padding="SAME")(y)
+        y = conv(self.filters, (3, 3), padding=[(1, 1), (1, 1)])(y)
         y = norm()(y)
         if residual.shape != y.shape:
             residual = conv(self.filters, (1, 1),

@@ -29,7 +29,10 @@ class VGG11BN(nn.Module):
             if v == "M":
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
             else:
-                x = nn.Conv(v, (3, 3), padding="SAME", use_bias=False,
+                # bias kept despite the following BN: torchvision's
+                # make_layers leaves Conv2d bias on in vgg11_bn, and exact
+                # param/state_dict parity matters for pretrained loading.
+                x = nn.Conv(v, (3, 3), padding="SAME", use_bias=True,
                             dtype=self.dtype)(x)
                 x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                                  dtype=self.dtype)(x)

@@ -58,6 +58,21 @@ def test_epochs_per_dispatch_below_one_exits_cleanly(tmp_path):
     assert rc == 1
 
 
+def test_use_pretrained_without_path_exits_cleanly(tmp_path):
+    rc = main(_argv(tmp_path, "--dataset", "synthetic", "--model", "resnet",
+                    "-e", "1", "--use-pretrained"))
+    assert rc == 1
+
+
+def test_use_pretrained_unsupported_arch_exits_cleanly(tmp_path):
+    w = tmp_path / "w.pth"
+    w.write_bytes(b"whatever")  # arch check fires before the file is read
+    rc = main(_argv(tmp_path, "--dataset", "synthetic", "--model", "cnn",
+                    "-e", "1", "--use-pretrained",
+                    "--pretrained-path", str(w)))
+    assert rc == 1
+
+
 def test_config_carries_fallback_flag():
     cfg = config_from_argv(["train", "-d", "/x", "--synthetic-fallback"])
     assert cfg.synthetic_fallback

@@ -30,15 +30,20 @@ def _shape_init(name, batch=2, num_classes=10):
     return m, variables, out
 
 
-# Param counts with 10 classes; resnet/alexnet/squeezenet/densenet match
-# torchvision's corresponding models exactly (verified against
-# torchvision resnet18/alexnet/squeezenet1_0/densenet121 head-swapped to 10
-# classes per ref utils.py:38-105).
+# Param counts with 10 classes; every torchvision-derived architecture is
+# pinned to torchvision's corresponding model head-swapped to 10 classes
+# (ref utils.py:38-105).  vgg11_bn: 132,868,840 total − 4,097,000 (1000-way
+# classifier[6]) + 40,970 (10-way) = 128,812,810 — torchvision keeps conv
+# bias on even with BN, so ours does too.  inception_v3 (aux_logits=True):
+# 27,161,264 − 2,049,000 (fc) − 769,000 (AuxLogits.fc) + 20,490 + 7,690
+# = 24,371,444 (both heads replaced, ref utils.py:93-98).
 _EXPECTED_PARAMS = {
     "resnet": 11_181_642,
     "alexnet": 57_044_810,
+    "vgg": 128_812_810,
     "squeezenet": 740_554,
     "densenet": 6_964_106,
+    "inception": 24_371_444,
 }
 
 
@@ -85,6 +90,15 @@ def test_bfloat16_compute_float32_params():
     for p in jax.tree_util.tree_leaves(v["params"]):
         assert p.dtype == jnp.float32  # master weights stay f32
     assert m.apply(v, x, train=False).dtype == jnp.float32  # logits f32
+
+
+def test_inception_small_input_trains_error_not_nan():
+    """Below the aux head's 17x17 feature-map floor, train mode raises a
+    clear error instead of silently producing NaN logits."""
+    m = models.get_model("inception", 10, half_precision=False)
+    x = jnp.zeros((2, 128, 128, 3), jnp.float32)
+    with pytest.raises(ValueError, match="aux head"):
+        jax.eval_shape(functools.partial(m.init, train=True), RNGS, x)
 
 
 def test_invalid_model_name_raises():

@@ -1,0 +1,129 @@
+"""Pretrained-weight conversion parity: torch state_dict -> Flax params.
+
+For each supported architecture a seeded random-weight torch model (exact
+torchvision topology + key names, tests/_torch_zoo.py) produces reference
+eval-mode logits; the converted Flax model must match on the same input.
+This validates the full mapping — conv/linear transposes, the NCHW->NHWC
+flatten permutation, BN param/stat split — so real torchvision ImageNet
+weights load correctly whenever the user supplies them
+(ref utils.py:38-105 use_pretrained).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from distributedpytorch_tpu import models
+from distributedpytorch_tpu.models import pretrained
+from distributedpytorch_tpu.ops.losses import get_loss_fn
+from distributedpytorch_tpu.train.engine import Engine, make_optimizer
+
+from tests._torch_zoo import TORCH_ZOO, randomize_bn_stats
+
+RNGS = {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)}
+
+
+def _flax_init(name, size):
+    m = models.get_model(name, 10, half_precision=False)
+    x = jnp.zeros((1, size, size, 3), jnp.float32)
+    v = m.init(RNGS, x, train=True)
+    return m, v["params"], v.get("batch_stats", {})
+
+
+@pytest.mark.parametrize("name", sorted(TORCH_ZOO))
+def test_converted_logits_match_torch(name):
+    torch.manual_seed(42)
+    tmodel = TORCH_ZOO[name](num_classes=10)
+    randomize_bn_stats(tmodel, seed=7)
+    tmodel.eval()
+
+    size = 224
+    m, params, batch_stats = _flax_init(name, size)
+    params, batch_stats = pretrained.convert_state_dict(
+        name, {k: v.numpy() for k, v in tmodel.state_dict().items()},
+        params, batch_stats)
+
+    # The head stays freshly initialized (replace-after-load semantics,
+    # ref utils.py:46-48); copy it INTO the torch model for comparison.
+    head_t = tmodel.fc if name == "resnet" else tmodel.classifier[6]
+    with torch.no_grad():
+        head_t.weight.copy_(torch.from_numpy(
+            np.asarray(params["head"]["kernel"]).T))
+        head_t.bias.copy_(torch.from_numpy(
+            np.asarray(params["head"]["bias"])))
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(2, size, size, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = tmodel(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+
+    variables = {"params": params}
+    if jax.tree_util.tree_leaves(batch_stats):
+        variables["batch_stats"] = batch_stats
+    got = np.asarray(m.apply(variables, jnp.asarray(x), train=False))
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_unsupported_arch_raises():
+    _, params, stats = _flax_init("cnn", 28)
+    with pytest.raises(ValueError, match="not supported"):
+        pretrained.convert_state_dict("cnn", {}, params, stats)
+
+
+def test_missing_path_raises():
+    with pytest.raises(ValueError, match="pretrained-path"):
+        pretrained.load_pretrained("resnet", None, {}, {})
+
+
+def test_shape_mismatch_raises():
+    torch.manual_seed(0)
+    tmodel = TORCH_ZOO["resnet"](num_classes=10)
+    sd = {k: v.numpy() for k, v in tmodel.state_dict().items()}
+    sd["conv1.weight"] = sd["conv1.weight"][:, :1]  # break a shape
+    _, params, stats = _flax_init("resnet", 64)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        pretrained.convert_state_dict("resnet", sd, params, stats)
+
+
+def test_feature_extract_finetune_trains_head_only(tmp_path):
+    """The reference's whole fine-tuning story (ref config.py:48-51):
+    pretrained backbone + feature_extract trains ONLY the head."""
+    torch.manual_seed(1)
+    tmodel = TORCH_ZOO["resnet"](num_classes=10)
+    path = tmp_path / "resnet18.pth"
+    torch.save(tmodel.state_dict(), str(path))
+
+    size = 64  # reduced input: resnet is size-agnostic (global pool)
+    model = models.get_model("resnet", 10, half_precision=False)
+    tx = make_optimizer("adam", 1e-3, 0.9, 0.1, steps_per_epoch=4,
+                        feature_extract=True)
+    engine = Engine(model, "resnet", get_loss_fn("cross_entropy"), tx,
+                    mean=0.45, std=0.2, input_size=size,
+                    half_precision=False)
+    state = engine.init_state(jax.random.PRNGKey(0), 1)
+    params, stats = pretrained.load_pretrained(
+        "resnet", str(path), state.params, state.batch_stats)
+    state = state.replace(params=params, batch_stats=stats)
+
+    backbone_before = np.asarray(params["Conv_0"]["kernel"]).copy()
+    head_before = np.asarray(params["head"]["kernel"]).copy()
+    # backbone got the torch weights
+    np.testing.assert_allclose(
+        backbone_before,
+        tmodel.state_dict()["conv1.weight"].numpy().transpose(2, 3, 1, 0))
+
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, size=(2, size, size), dtype=np.uint8)
+    labels = rng.integers(0, 10, size=(2,)).astype(np.int32)
+    state, metrics = engine.train_step(state, images, labels,
+                                       np.ones(2, bool),
+                                       jax.random.PRNGKey(1))
+    assert np.isfinite(float(metrics["loss"]))
+    np.testing.assert_array_equal(
+        np.asarray(state.params["Conv_0"]["kernel"]), backbone_before)
+    assert not np.allclose(np.asarray(state.params["head"]["kernel"]),
+                           head_before)

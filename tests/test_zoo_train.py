@@ -1,0 +1,72 @@
+"""Every registry model executes one REAL optimization step.
+
+Round-1 gap: the heavy architectures were only ever shape-checked with
+``jax.eval_shape`` — runtime-only failure modes (dropout rng wiring, BN
+mutable collections under ``value_and_grad``, inception's train-mode
+(logits, aux) tuple through the engine, bf16 numerics) were unexercised.
+This runs the full engine step — on-device augmentation, forward, backward,
+update — with real numerics for all 8 models (ref utils.py:38-105), at
+reduced input sizes where the topology allows (adaptive pooling makes the
+224/299 models size-agnostic) so the suite stays tractable on CPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu import models
+from distributedpytorch_tpu.ops.losses import get_loss_fn
+from distributedpytorch_tpu.train.engine import Engine, make_optimizer
+
+# Reduced sizes for CPU tractability; the real registry sizes (224/299,
+# ref utils.py:24-36) are covered by the shape suite in test_models.py.
+# Inception must run at native 299: its aux head needs a 17x17 feature map
+# (enforced with a trace-time error — see models/inception.py AuxHead).
+_TEST_SIZES = {
+    "cnn": 28, "mlp": 28, "resnet": 64, "alexnet": 64, "vgg": 64,
+    "squeezenet": 64, "densenet": 64, "inception": 299,
+}
+
+
+def _flat(params):
+    return np.concatenate([np.asarray(p, np.float64).ravel()
+                           for p in jax.tree_util.tree_leaves(params)])
+
+
+@pytest.mark.parametrize("name", sorted(models.MODEL_REGISTRY))
+def test_one_real_train_step(name):
+    size = _TEST_SIZES[name]
+    model = models.get_model(name, 10, half_precision=False)
+    tx = make_optimizer("adam", 1e-3, 0.9, 0.1, steps_per_epoch=4,
+                        feature_extract=False)
+    engine = Engine(model, name, get_loss_fn("cross_entropy"), tx,
+                    mean=0.45, std=0.2, input_size=size,
+                    half_precision=False)
+    state = engine.init_state(jax.random.PRNGKey(0), 1)
+    before = _flat(state.params)
+    aux_before = (_flat(state.params["AuxHead_0"])
+                  if name in models.registry.AUX_LOGIT_MODELS else None)
+
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, size=(2, size, size), dtype=np.uint8)
+    labels = rng.integers(0, 10, size=(2,)).astype(np.int32)
+    valid = np.ones(2, dtype=bool)
+
+    state, metrics = engine.train_step(state, images, labels, valid,
+                                       jax.random.PRNGKey(1))
+    assert int(state.step) == 1
+    assert np.isfinite(float(metrics["loss"])), name
+    after = _flat(state.params)
+    assert not np.allclose(before, after), f"{name}: params did not change"
+
+    ev = engine.eval_step(state, images, labels, valid)
+    assert np.isfinite(float(ev["loss_numer"])), name
+    assert float(ev["valid"]) == 2.0
+
+    if aux_before is not None:
+        # the aux head must also receive gradient (loss1 + 0.4*loss2,
+        # ref classif.py:49-53)
+        aux_after = _flat(state.params["AuxHead_0"])
+        assert not np.allclose(aux_before, aux_after), \
+            f"{name}: aux head got no gradient"
