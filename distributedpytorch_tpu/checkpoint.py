@@ -36,15 +36,19 @@ from .train.engine import TrainState
 _FORMAT_VERSION = 1
 
 
-def _gather_replicated(state: TrainState) -> TrainState:
+def gather_replicated(state: TrainState) -> TrainState:
     """Make every array fully replicated before host transfer.
 
     With --model-parallel, params/opt-state live sharded over the 'model'
     mesh axis; on multi-host meshes ``jax.device_get`` of such arrays would
     fail (non-addressable shards).  A jitted identity with replicated
-    out_shardings performs the all-gather as an XLA program, which is
-    multi-host-safe.  No-op (and no dispatch) for the default replicated
-    layout.
+    out_shardings performs the all-gather as an XLA program.  No-op (and no
+    dispatch) for the default replicated layout.
+
+    COLLECTIVE on multi-host meshes: when any leaf is sharded over a mesh
+    spanning multiple processes, EVERY process must call this (the program
+    runs on all the mesh's devices) — drivers call it un-gated and then
+    gate only the file write on ``is_main()``.
     """
     leaves = [a for a in jax.tree_util.tree_leaves(state)
               if isinstance(a, jax.Array)]
@@ -53,8 +57,18 @@ def _gather_replicated(state: TrainState) -> TrainState:
     mesh = next(a.sharding.mesh for a in leaves
                 if isinstance(a.sharding, NamedSharding))
     replicated = NamedSharding(mesh, PartitionSpec())
-    shardings = jax.tree_util.tree_map(lambda _: replicated, state)
-    return jax.jit(lambda x: x, out_shardings=shardings)(state)
+    gather = jax.jit(lambda x: x, out_shardings=replicated)
+
+    def _one(a):
+        # Leaf-by-leaf, not one whole-tree program: bounds the transient
+        # HBM spike to sharded-state + ONE replicated tensor, instead of
+        # re-materializing the full unsharded state (the exact footprint
+        # --model-parallel exists to avoid) on every device at save time.
+        if isinstance(a, jax.Array) and not a.is_fully_replicated:
+            return jax.device_get(gather(a))
+        return a
+
+    return jax.tree_util.tree_map(_one, state)
 
 
 def checkpoint_path(rsl_path: str, dataset: str, model_name: str,
@@ -71,14 +85,17 @@ def best_model_path(rsl_path: str, dataset: str, model_name: str) -> str:
 
 def save_checkpoint(path: str, model_name: str, state: TrainState,
                     epoch: int, best_valid_loss: float) -> None:
-    """ref saveCheckpoint (utils.py:112-121); caller gates on is_main()."""
+    """ref saveCheckpoint (utils.py:112-121); caller gates on is_main() —
+    but on multi-host meshes the caller must run ``gather_replicated`` on
+    every process FIRST and pass the gathered state (the internal call
+    below is then a no-op; it only covers single-host callers)."""
     payload = {
         "format_version": _FORMAT_VERSION,
         "model_name": model_name,
         "epoch": int(epoch),
         "loss": float(best_valid_loss),
         "state": serialization.to_state_dict(
-            jax.device_get(_gather_replicated(state))),
+            jax.device_get(gather_replicated(state))),
     }
     blob = serialization.msgpack_serialize(payload)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -118,7 +135,7 @@ def load_checkpoint(path: str, state: TrainState,
     best_valid_loss).  ``state`` is a template with the right structure
     (fresh Engine.init_state output); restored arrays replace its leaves."""
     payload = _read(path)
-    template = jax.device_get(_gather_replicated(state))
+    template = jax.device_get(gather_replicated(state))
     if not restore_optimizer:  # test path passes optimizer=None (ref :232)
         payload["state"]["opt_state"] = serialization.to_state_dict(
             template).get("opt_state", {})

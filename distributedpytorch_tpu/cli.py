@@ -52,12 +52,35 @@ def _place_state(state, mesh, cfg: Config):
     return jax.device_put(state, runtime.replicated_sharding(mesh))
 
 
+RESIDENT_HBM_FRACTION = 0.3
+
+
+def _resident_budget_bytes(cfg: Config) -> int:
+    """Byte cap for keeping one split device-resident under 'auto'.
+
+    Residency replicates the raw split to EVERY device (pipeline.py
+    ResidentLoader), so the cost is per-replica HBM.  The budget is the
+    configured --resident-max-bytes cap, further bounded to 30% of the
+    device's reported memory when the backend exposes it — train and valid
+    splits are both resident (~1.1x train combined), and params, optimizer
+    state, activations, and XLA workspace need the rest, so 30% per split
+    keeps a documented >=40% headroom even in the worst case.  Explicit
+    --data-mode resident bypasses this (the user asserted it fits).
+    """
+    budget = cfg.resident_max_bytes
+    hbm = runtime.device_memory_limit()
+    if hbm is not None:
+        budget = min(budget, int(RESIDENT_HBM_FRACTION * hbm))
+    return budget
+
+
 def _make_loader(cfg: Config, split: Split, mesh, shuffle: bool):
     """Pick resident (whole split in HBM, one dispatch per epoch) vs
-    streamed batching.  'auto' keeps small corpora on device."""
+    streamed batching.  'auto' keeps small corpora on device, bounded by
+    the actual device memory (see _resident_budget_bytes)."""
     resident = (cfg.data_mode == "resident"
                 or (cfg.data_mode == "auto"
-                    and split.images.nbytes <= cfg.resident_max_bytes))
+                    and split.images.nbytes <= _resident_budget_bytes(cfg)))
     cls = ResidentLoader if resident else ShardedLoader
     return cls(split, mesh, cfg.batch_size, shuffle=shuffle, seed=cfg.seed,
                prefetch=cfg.prefetch)
@@ -146,8 +169,6 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
     cadence — only the chunk-final state exists on host, so the rolling
     checkpoint (and any best-model save) happens once per chunk.
     """
-    import numpy as np
-
     history = []
     epoch = start_epoch
     while epoch < cfg.nb_epochs:
@@ -204,6 +225,9 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
                             "valid_acc": valid_acc})
 
         last = chunk[-1]
+        # Collective on multi-host model-parallel meshes: every process
+        # joins the all-gather; only main writes the files below.
+        saveable = ckpt.gather_replicated(state)
         if runtime.is_main():
             ckpt.rotate_checkpoint(cfg.rsl_path, cfg.dataset, model_name,
                                    last)
@@ -213,7 +237,7 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
             ckpt.save_checkpoint(
                 ckpt.checkpoint_path(cfg.rsl_path, cfg.dataset, model_name,
                                      last),
-                model_name, state, last, best_valid_loss)
+                model_name, saveable, last, best_valid_loss)
             if chunk_improved:
                 # Only the chunk-final state exists on host, so the best
                 # file holds it (an approximation of the true best epoch
@@ -223,10 +247,10 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
                 ckpt.save_checkpoint(
                     ckpt.best_model_path(cfg.rsl_path, cfg.dataset,
                                          model_name),
-                    model_name, state, last, best_valid_loss)
+                    model_name, saveable, last, best_valid_loss)
         epoch = last + 1
     return {"history": history, "best_valid_loss": best_valid_loss,
-            "model_name": model_name}
+            "model_name": model_name, "state": state}
 
 
 def run_train(cfg: Config) -> dict:
@@ -244,6 +268,16 @@ def run_train(cfg: Config) -> dict:
                      f"prefetch: {cfg.prefetch}")
         runtime.check_devices()
 
+    if cfg.use_pretrained and cfg.checkpoint_file:
+        # use_pretrained must never silently no-op (pretrained.py contract);
+        # on resume every weight comes from the checkpoint, so the combined
+        # request is a contradiction, not an ignorable flag.  Checked
+        # before the checkpoint file is ever read: the conflict is real
+        # whether or not the file exists.
+        raise ValueError(
+            "--use-pretrained cannot be combined with -f/--file resume: "
+            "all weights come from the checkpoint")
+
     # Model name: resume reads it from the checkpoint (fixes SURVEY defect
     # #3 — ref classif.py:93 calls a misspelled helper and crashes).
     if cfg.checkpoint_file:
@@ -255,7 +289,7 @@ def run_train(cfg: Config) -> dict:
         raise ValueError(
             f"--epochs-per-dispatch must be >= 1, got "
             f"{cfg.epochs_per_dispatch}")
-    if cfg.use_pretrained and not cfg.checkpoint_file:
+    if cfg.use_pretrained:
         # Fail unsupported-arch / missing-path mistakes here, before the
         # dataset load and model init pay for a doomed run.
         pretrained.validate_request(model_name, cfg.pretrained_path)
@@ -348,6 +382,9 @@ def run_train(cfg: Config) -> dict:
         improved = valid_loss < best_valid_loss
         if improved:
             best_valid_loss = valid_loss
+        # Collective on multi-host model-parallel meshes: every process
+        # joins the all-gather; only main writes the files below.
+        saveable = ckpt.gather_replicated(state)
         if runtime.is_main():  # ref classif.py:176-192
             logging.info(
                 f"{'*' if improved else ' '} Epoch: {epoch + 1:03}  "
@@ -365,21 +402,29 @@ def run_train(cfg: Config) -> dict:
             ckpt.save_checkpoint(
                 ckpt.checkpoint_path(cfg.rsl_path, cfg.dataset, model_name,
                                      epoch),
-                model_name, state, epoch, best_valid_loss)
+                model_name, saveable, epoch, best_valid_loss)
             if improved:
                 ckpt.save_checkpoint(
                     ckpt.best_model_path(cfg.rsl_path, cfg.dataset,
                                          model_name),
-                    model_name, state, epoch, best_valid_loss)
+                    model_name, saveable, epoch, best_valid_loss)
         history.append({"epoch": epoch, "train_loss": train_loss,
                         "train_acc": train_acc, "valid_loss": valid_loss,
                         "valid_acc": valid_acc})
+    # Final state is returned so callers (multi-process tests, notebooks)
+    # can inspect the trained parameters without re-reading a checkpoint.
     return {"history": history, "best_valid_loss": best_valid_loss,
-            "model_name": model_name}
+            "model_name": model_name, "state": state}
 
 
 def run_test(cfg: Config) -> dict:
     """ref test() (classif.py:197-243), TPU-native."""
+    if cfg.use_pretrained:
+        # Same never-silently-no-op contract as run_train: eval weights
+        # come from -f FILE, so the flag is a contradiction here.
+        raise ValueError(
+            "--use-pretrained is not applicable to the test subcommand: "
+            "weights come from -f FILE")
     runtime.initialize_distributed()
     utils.initialize_logging(cfg.rsl_path, cfg.log_file,
                              truncate=runtime.is_main())

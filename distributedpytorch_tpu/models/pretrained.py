@@ -207,6 +207,12 @@ def load_pretrained(model_name: str, path: Optional[str],
     except Exception as e:
         raise ValueError(f"cannot load pretrained weights {path!r}: {e}") \
             from e
-    sd = obj.get("state_dict", obj) if isinstance(obj, dict) else obj
+    if not isinstance(obj, dict):
+        # e.g. a bare tensor or scripted module: surface as ValueError so
+        # the CLI log-and-exits instead of tracebacking (ref error style).
+        raise ValueError(
+            f"pretrained weights {path!r} did not contain a state_dict "
+            f"(got {type(obj).__name__})")
+    sd = obj.get("state_dict", obj)
     sd = {k: v.numpy() if hasattr(v, "numpy") else v for k, v in sd.items()}
     return convert_state_dict(model_name, sd, params, batch_stats)

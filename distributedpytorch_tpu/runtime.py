@@ -37,6 +37,26 @@ MODEL_AXIS = "model"
 _initialized = False
 
 
+def _multihost_env() -> bool:
+    """True when the environment indicates a multi-host run.
+
+    Covers both the explicit coordinator vars (set by launch tooling /
+    ourselves) and the markers libtpu sets on Cloud TPU pod slices, where
+    ``jax.distributed.initialize()`` auto-discovers the coordinator from
+    TPU metadata without any vars of ours.  A plain single-host TPU VM sets
+    none of these (or a single-entry hostname list), so the no-op single
+    host path stays a no-op.
+    """
+    if any(v in os.environ for v in
+           ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+            "MEGASCALE_COORDINATOR_ADDRESS")):
+        return True
+    # Cloud TPU pod markers: a multi-entry worker list means this process
+    # is one of several hosts and MUST join the rendezvous.
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hostnames.split(",") if h]) > 1
+
+
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
                            process_id: Optional[int] = None) -> None:
@@ -44,17 +64,22 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
 
     TPU equivalent of ref classif.py:86-87 (init_process_group) + the env-var
     plumbing at ref main.py:128-131.  On TPU pods the coordinator is
-    discovered from the environment automatically; args are an escape hatch
-    for manual clusters (the moral equivalent of the reference's DDTNodes
-    table, but optional).
+    discovered from the environment automatically (see ``_multihost_env``);
+    args are an escape hatch for manual clusters (the moral equivalent of
+    the reference's DDTNodes table, but optional) — and the path the
+    multi-process CPU test drives.
     """
     global _initialized
     if _initialized:
         return
-    explicit = coordinator_address is not None
-    multihost_env = any(v in os.environ for v in
-                        ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS"))
-    if explicit or multihost_env:
+    if coordinator_address is not None or _multihost_env():
+        # Cross-process collectives on the CPU backend need gloo (the
+        # multi-process test path; TPU runs ignore this — platform
+        # selection happens later and TPU collectives ride ICI/DCN).
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # older/newer jax without the option
+            pass
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
@@ -115,6 +140,25 @@ def data_sharding(mesh: Mesh) -> NamedSharding:
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     """Params / opt state: fully replicated (pure data parallelism)."""
     return NamedSharding(mesh, P())
+
+
+def device_memory_limit() -> Optional[int]:
+    """Per-device accelerator memory in bytes, or None when unknown.
+
+    TPU/GPU backends report ``bytes_limit`` via ``Device.memory_stats()``;
+    the CPU backend (and some virtual-device setups) report nothing — then
+    residency decisions fall back to the configured byte cap alone.
+    """
+    limits = []
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            return None
+        if not stats or "bytes_limit" not in stats:
+            return None
+        limits.append(int(stats["bytes_limit"]))
+    return min(limits) if limits else None
 
 
 def check_devices() -> bool:

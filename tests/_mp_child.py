@@ -1,0 +1,101 @@
+"""Child process for the multi-process distributed test (test_multiprocess.py).
+
+Run as a plain ``python tests/_mp_child.py`` subprocess — one per simulated
+host.  Each child provisions its own local virtual CPU devices, joins the
+gloo rendezvous via ``runtime.initialize_distributed`` (the TPU-native
+equivalent of the reference's per-node ``init_process_group``, ref
+classif.py:86-87 + main.py:128-135), runs one epoch of ``run_train`` on both
+the device-resident and the streaming data path, and dumps its local copy of
+the final parameters for the parent to compare across ranks.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coord", required=True)
+    ap.add_argument("--nproc", type=int, required=True)
+    ap.add_argument("--pid", type=int, required=True)
+    ap.add_argument("--devices-per-proc", type=int, default=2)
+    ap.add_argument("--rsl", required=True)
+    ap.add_argument("--out", required=True)
+    a = ap.parse_args()
+
+    # Local device fan-out + platform must be pinned before any backend
+    # init; the rendezvous must happen before that too.
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={a.devices_per_proc}")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+    from distributedpytorch_tpu import runtime
+
+    runtime.initialize_distributed(coordinator_address=a.coord,
+                                   num_processes=a.nproc, process_id=a.pid)
+    assert jax.process_count() == a.nproc, jax.process_count()
+    assert jax.device_count() == a.nproc * a.devices_per_proc
+    assert runtime.is_main() == (a.pid == 0)
+
+    import numpy as np
+
+    from distributedpytorch_tpu.cli import run_train
+    from distributedpytorch_tpu.config import Config
+
+    def local_copy(tree):
+        # Replicated jax.Arrays: every process holds full copies on its own
+        # devices — read this process's copy without a cross-host gather.
+        # gather_replicated output may already be host numpy arrays.
+        return [np.asarray(leaf.addressable_shards[0].data)
+                if hasattr(leaf, "addressable_shards") else np.asarray(leaf)
+                for leaf in jax.tree_util.tree_leaves(tree)]
+
+    out = {}
+    # Device-resident path: epoch_plan / epoch_plan_many go through
+    # jax.make_array_from_process_local_data (pipeline.py _put_global).
+    cfg = Config(action="train", data_path="/tmp/nodata",
+                 rsl_path=os.path.join(a.rsl, f"rank{a.pid}"),
+                 dataset="synthetic", model_name="cnn", batch_size=4,
+                 nb_epochs=1, debug=True, half_precision=False)
+    result = run_train(cfg)
+    for i, leaf in enumerate(local_copy(result["state"].params)):
+        out[f"resident_p{i}"] = leaf
+    history = {"resident": result["history"]}
+
+    # Streaming path: per-batch make_array_from_process_local_data
+    # (pipeline.py ShardedLoader._to_device).
+    cfg_s = cfg.replace(model_name="mlp", data_mode="stream",
+                        rsl_path=os.path.join(a.rsl, f"rank{a.pid}_s"))
+    result_s = run_train(cfg_s)
+    for i, leaf in enumerate(local_copy(result_s["state"].params)):
+        out[f"stream_p{i}"] = leaf
+    history["stream"] = result_s["history"]
+
+    # Model-parallel path: params/opt-state sharded over the 'model' axis
+    # ACROSS hosts — the end-of-epoch checkpoint save must all-gather
+    # collectively on every process (checkpoint.gather_replicated) before
+    # main writes; a main-only dispatch would deadlock here.
+    if (a.nproc * a.devices_per_proc) % 2 == 0:
+        from distributedpytorch_tpu import checkpoint as ckpt
+
+        cfg_mp = cfg.replace(model_name="mlp", model_parallel=2,
+                             rsl_path=os.path.join(a.rsl, f"rank{a.pid}_mp"))
+        result_mp = run_train(cfg_mp)
+        gathered = ckpt.gather_replicated(result_mp["state"])
+        for i, leaf in enumerate(local_copy(gathered.params)):
+            out[f"mp_p{i}"] = leaf
+        history["mp"] = result_mp["history"]
+
+    np.savez(a.out, **out)
+    with open(a.out + ".history.json", "w") as f:
+        json.dump(history, f)
+    print(f"rank {a.pid} done", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

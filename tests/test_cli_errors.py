@@ -77,3 +77,44 @@ def test_config_carries_fallback_flag():
     cfg = config_from_argv(["train", "-d", "/x", "--synthetic-fallback"])
     assert cfg.synthetic_fallback
     assert not config_from_argv(["train", "-d", "/x"]).synthetic_fallback
+
+def test_use_pretrained_with_resume_exits_cleanly(tmp_path):
+    """--use-pretrained + -f is a contradiction (all weights come from the
+    checkpoint); it must error, never silently ignore the flag — and the
+    guard must fire before the checkpoint file is ever read (pinned via
+    the message: a missing -f file would raise 'cannot read checkpoint')."""
+    import pytest as _pytest
+
+    from distributedpytorch_tpu.cli import run_train
+
+    cfg = config_from_argv(_argv(tmp_path, "--dataset", "synthetic",
+                                 "--model", "resnet", "-e", "1",
+                                 "--use-pretrained",
+                                 "--pretrained-path", str(tmp_path / "w.pth"),
+                                 "-f", str(tmp_path / "some.ckpt")))
+    with _pytest.raises(ValueError, match="cannot be combined"):
+        run_train(cfg)
+    assert main(_argv(tmp_path, "--dataset", "synthetic", "--model",
+                      "resnet", "-e", "1", "--use-pretrained",
+                      "--pretrained-path", str(tmp_path / "w.pth"),
+                      "-f", str(tmp_path / "some.ckpt"))) == 1
+
+
+def test_use_pretrained_on_test_subcommand_exits_cleanly(tmp_path):
+    rc = main(["test", "-d", str(tmp_path), "--rsl_path", str(tmp_path),
+               "--dataset", "synthetic", "--debug", "--use-pretrained",
+               "-f", str(tmp_path / "some.ckpt")])
+    assert rc == 1
+
+
+def test_pretrained_file_without_state_dict_exits_cleanly(tmp_path):
+    """A .pth holding a bare tensor (not a state_dict) must surface as the
+    CLI's log-and-exit, not an AttributeError traceback."""
+    import torch
+
+    w = tmp_path / "bare.pth"
+    torch.save(torch.zeros(3), str(w))
+    rc = main(_argv(tmp_path, "--dataset", "synthetic", "--model", "resnet",
+                    "-e", "1", "--use-pretrained",
+                    "--pretrained-path", str(w)))
+    assert rc == 1
