@@ -1,0 +1,126 @@
+"""Analytic FLOP accounting (SURVEY §5 metrics/observability).
+
+Counts multiply-add FLOPs (2 x MACs) of the matmul/conv primitives in a
+function's jaxpr — the standard model-FLOPs convention (elementwise ops are
+ignored; they are bandwidth-, not FLOP-bound on TPU).  Used by bench.py for
+MFU: the TPU executable's own ``cost_analysis()`` reports per-partition
+post-fusion estimates that undercount by orders of magnitude, so MFU must
+come from the analytic model count, as every published MFU number does.
+
+The reference has no FLOPs/MFU accounting anywhere (its only metrics are
+wall-clock + accuracy, ref classif.py:171-178, utils.py:158-162) — this is
+framework-added observability, flagged as a divergence-by-addition.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def _prod(xs) -> int:
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+def _dot_general_flops(eqn) -> float:
+    (lc, rc), (lb, _rb) = eqn.params["dimension_numbers"]
+    lshape = eqn.invars[0].aval.shape
+    rshape = eqn.invars[1].aval.shape
+    batch = _prod(lshape[i] for i in lb)
+    k = _prod(lshape[i] for i in lc)
+    m = _prod(lshape[i] for i in range(len(lshape))
+              if i not in set(lb) | set(lc))
+    n = _prod(rshape[i] for i in range(len(rshape))
+              if i not in set(_rb) | set(rc))
+    return 2.0 * batch * m * n * k
+
+
+def _conv_flops(eqn) -> float:
+    dn = eqn.params["dimension_numbers"]
+    out_shape = eqn.outvars[0].aval.shape
+    rhs_shape = eqn.invars[1].aval.shape
+    # Kernel input-feature size is already divided by feature_group_count
+    # in the kernel's shape, so no extra correction is needed.
+    k_in = rhs_shape[dn.rhs_spec[1]]
+    k_spatial = _prod(rhs_shape[i] for i in dn.rhs_spec[2:])
+    return 2.0 * _prod(out_shape) * k_spatial * k_in
+
+
+def jaxpr_flops(jaxpr) -> float:
+    """Matmul+conv FLOPs of one (open) jaxpr, recursing into sub-jaxprs."""
+    total = 0.0
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "dot_general":
+            total += _dot_general_flops(eqn)
+        elif name == "conv_general_dilated":
+            total += _conv_flops(eqn)
+        elif name == "scan":
+            total += (eqn.params["length"]
+                      * jaxpr_flops(eqn.params["jaxpr"].jaxpr))
+        elif name == "while":
+            # Unknown trip count: count one body iteration (callers that
+            # need exactness should not hide matmuls in while loops).
+            total += jaxpr_flops(eqn.params["body_jaxpr"].jaxpr)
+        elif name == "cond":
+            total += max((jaxpr_flops(b.jaxpr)
+                          for b in eqn.params["branches"]), default=0.0)
+        else:
+            # Generic containers: pjit, remat/checkpoint, custom_jvp/vjp, …
+            for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+                sub = eqn.params.get(key)
+                if sub is not None:
+                    total += jaxpr_flops(getattr(sub, "jaxpr", sub))
+                    break
+    return total
+
+
+def forward_flops(model: Any, params: Any, batch_stats: Any,
+                  batch: int, input_size: int,
+                  dtype=jnp.float32) -> float:
+    """FLOPs of one inference forward pass at the given batch size.
+
+    Traces abstractly (no compute, no device use).  ``batch_stats`` may be
+    an empty dict for BN-free models.
+    """
+    x = jax.ShapeDtypeStruct((batch, input_size, input_size, 3), dtype)
+
+    has_bn = len(jax.tree_util.tree_leaves(batch_stats)) > 0
+
+    def fwd(p, bs, imgs):
+        variables = {"params": p}
+        if has_bn:
+            variables["batch_stats"] = bs
+        return model.apply(variables, imgs, train=False)
+
+    closed = jax.make_jaxpr(fwd)(params, batch_stats, x)
+    return jaxpr_flops(closed.jaxpr)
+
+
+def train_flops_per_sample(model: Any, params: Any, batch_stats: Any,
+                           batch: int, input_size: int,
+                           dtype=jnp.float32) -> float:
+    """Model FLOPs of one training step, per sample.
+
+    The standard estimate: backward costs ~2x forward (grad wrt inputs +
+    grad wrt weights), so train = 3 x forward.  Optimizer/elementwise work
+    is excluded by convention (it is negligible next to the matmuls for
+    conv nets and would not run on the MXU anyway).
+    """
+    fwd = forward_flops(model, params, batch_stats, batch, input_size,
+                        dtype)
+    return 3.0 * fwd / batch
+
+
+def human_flops(flops: float) -> str:
+    if flops <= 0:
+        return "0"
+    exp = min(int(math.log10(flops)) // 3, 6)
+    unit = ["", "K", "M", "G", "T", "P", "E"][exp]
+    return f"{flops / 10 ** (3 * exp):.2f} {unit}FLOP"

@@ -1,0 +1,267 @@
+#!/usr/bin/env python3
+"""Accuracy parity: this framework vs the reference's training loop, on the
+SAME corpus, seed, batch size, and epoch budget.
+
+The reference's own run recipe (ref classif.py:75-243: CNN + Adam(1e-3) +
+CE, batch 64, 2 epochs, train/valid/test with best-model tracking) is
+re-created faithfully in torch on host CPU — the only hardware the
+reference can use in this environment — including its per-sample transform
+pipeline (ref dataloader.py:98-116: RandomRotation(5, NEAREST, fill 0) ->
+RandomResizedCrop(bilinear) -> 3-channel repeat -> Normalize), implemented
+with PIL exactly as torchvision implements it (torchvision is not installed
+here).  Ours runs through the real CLI drivers (run_train/run_test).
+
+Corpus: real MNIST IDX files when present under --data-path (fetch with
+scripts/fetch_mnist.sh on a machine with egress; this environment has
+none), else the deterministic synthetic corpus — BOTH sides always see the
+identical arrays and the identical 90/10 split, so the two final accuracy
+columns are directly comparable either way.
+
+Output: one JSON line with both sides' valid/test accuracies + a markdown
+row for BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import random
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------- corpus --
+
+def load_corpus(dataset: str, data_path: str, seed: int):
+    """(train, valid, test) Splits + mean/std — via the framework's own
+    loader so both sides share arrays, stats, and the 90/10 split."""
+    from distributedpytorch_tpu.data.datasets import load_dataset
+
+    ds = load_dataset(dataset, data_path, seed,
+                      synthetic_fallback=(dataset == "synthetic"))
+    return ds
+
+
+# ------------------------------------------------------- reference (torch) --
+
+def run_reference(ds, epochs: int, batch: int, seed: int,
+                  train_limit: int) -> dict:
+    """The reference's train()+test() flow, faithfully (ref classif.py),
+    with its transform pipeline done per-sample in PIL on host CPU."""
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+    from PIL import Image
+
+    torch.manual_seed(seed)
+    np_rng = np.random.default_rng(seed)
+    py_rng = random.Random(seed)
+
+    mean, std = ds.mean, ds.std
+    size = ds.splits["train"].images.shape[1]  # 28
+
+    def to_tensor(arr_f32: np.ndarray) -> torch.Tensor:
+        x = torch.from_numpy(arr_f32 / 255.0).float()
+        x = x[None].repeat(3, 1, 1)            # TensorRepeat(3)
+        return (x - mean) / std                # Normalize
+
+    def train_transform(img_u8: np.ndarray) -> torch.Tensor:
+        im = Image.fromarray(img_u8, mode="L")
+        # RandomRotation(5, fill=0): torchvision default NEAREST resample.
+        angle = py_rng.uniform(-5.0, 5.0)
+        im = im.rotate(angle, resample=Image.NEAREST, fillcolor=0)
+        # RandomResizedCrop(size): torchvision's sampling loop.
+        area = size * size
+        for _ in range(10):
+            target = area * py_rng.uniform(0.08, 1.0)
+            ratio = math.exp(py_rng.uniform(math.log(3 / 4), math.log(4 / 3)))
+            w = int(round(math.sqrt(target * ratio)))
+            h = int(round(math.sqrt(target / ratio)))
+            if 0 < w <= size and 0 < h <= size:
+                top = py_rng.randint(0, size - h)
+                left = py_rng.randint(0, size - w)
+                break
+        else:
+            w = h = min(size, size)
+            top = (size - h) // 2
+            left = (size - w) // 2
+        im = im.crop((left, top, left + w, top + h)).resize(
+            (size, size), Image.BILINEAR)
+        return to_tensor(np.asarray(im, dtype=np.float32))
+
+    def eval_transform(img_u8: np.ndarray) -> torch.Tensor:
+        # Resize(size) -> CenterCrop(size): identity at native resolution.
+        return to_tensor(img_u8.astype(np.float32))
+
+    class SmallCNNTorch(nn.Module):
+        """Same topology as the framework's flagship 'cnn'."""
+
+        def __init__(self):
+            super().__init__()
+            self.c1 = nn.Conv2d(3, 32, 3, padding=1)
+            self.c2 = nn.Conv2d(32, 32, 3, padding=1)
+            self.c3 = nn.Conv2d(32, 64, 3, padding=1)
+            self.c4 = nn.Conv2d(64, 64, 3, padding=1)
+            self.fc1 = nn.Linear(64 * (size // 4) ** 2, 256)
+            self.head = nn.Linear(256, ds.nb_classes)
+
+        def forward(self, x):
+            x = F.relu(self.c2(F.relu(self.c1(x))))
+            x = F.max_pool2d(x, 2)
+            x = F.relu(self.c4(F.relu(self.c3(x))))
+            x = F.max_pool2d(x, 2)
+            return self.head(F.relu(self.fc1(x.flatten(1))))
+
+    model = SmallCNNTorch()
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    criterion = nn.CrossEntropyLoss()
+
+    tr = ds.splits["train"]
+    n_train = len(tr) if train_limit <= 0 else min(train_limit, len(tr))
+
+    def run_epoch(split, training: bool, limit: int = 0) -> tuple:
+        n = len(split) if limit <= 0 else min(limit, len(split))
+        order = np_rng.permutation(len(split))[:n] if training \
+            else np.arange(n)
+        model.train(training)
+        total_loss, correct, seen = 0.0, 0, 0
+        tf = train_transform if training else eval_transform
+        with torch.set_grad_enabled(training):
+            for s in range(0, n, batch):
+                idx = order[s:s + batch]
+                x = torch.stack([tf(split.images[i]) for i in idx])
+                y = torch.from_numpy(
+                    split.labels[idx].astype(np.int64))
+                if training:
+                    opt.zero_grad()
+                out = model(x)
+                loss = criterion(out, y)
+                if training:
+                    loss.backward()
+                    opt.step()
+                total_loss += float(loss.detach()) * len(idx)
+                correct += int((out.argmax(1) == y).sum())
+                seen += len(idx)
+        return total_loss / seen, correct / seen
+
+    import copy
+
+    best_valid = float("inf")
+    valid_acc_at_best = 0.0
+    best_state = copy.deepcopy(model.state_dict())
+    tr_acc = float("nan")
+    t0 = time.monotonic()
+    for epoch in range(epochs):
+        tr_loss, tr_acc = run_epoch(tr, True, n_train)
+        va_loss, va_acc = run_epoch(ds.splits["valid"], False)
+        log(f"[ref] epoch {epoch}: train loss {tr_loss:.4f} "
+            f"acc {tr_acc:.4f} | valid loss {va_loss:.4f} acc {va_acc:.4f}")
+        if va_loss < best_valid:
+            best_valid, valid_acc_at_best = va_loss, va_acc
+            # snapshot like the reference's bestmodel checkpoint
+            # (ref classif.py:188-192), so the test column evaluates the
+            # best-valid model — symmetric with ours' best-checkpoint load.
+            best_state = copy.deepcopy(model.state_dict())
+    model.load_state_dict(best_state)
+    te_loss, te_acc = run_epoch(ds.splits["test"], False)
+    log(f"[ref] test acc {te_acc:.4f} ({time.monotonic() - t0:.0f}s)")
+    return {"valid_acc": valid_acc_at_best, "test_acc": te_acc,
+            "train_acc_final": tr_acc, "seconds": time.monotonic() - t0}
+
+
+# ------------------------------------------------------------------- ours --
+
+def run_ours(dataset: str, data_path: str, epochs: int, batch: int,
+             seed: int, rsl: str, train_limit: int) -> dict:
+    from distributedpytorch_tpu import checkpoint as ckpt
+    from distributedpytorch_tpu.cli import run_test, run_train
+    from distributedpytorch_tpu.config import Config
+
+    if train_limit > 0:
+        log("[ours] note: --train-limit applies only to the reference side "
+            "(ours trains the full split; limit exists to cap torch-CPU "
+            "wall-clock)")
+    t0 = time.monotonic()
+    cfg = Config(action="train", data_path=data_path, rsl_path=rsl,
+                 dataset=dataset, model_name="cnn", batch_size=batch,
+                 nb_epochs=epochs, seed=seed,
+                 synthetic_fallback=(dataset == "synthetic"))
+    result = run_train(cfg)
+    best = ckpt.best_model_path(rsl, dataset, "cnn")
+    test = run_test(Config(action="test", data_path=data_path, rsl_path=rsl,
+                           dataset=dataset, batch_size=batch, seed=seed,
+                           checkpoint_file=best,
+                           synthetic_fallback=(dataset == "synthetic")))
+    hist = result["history"]
+    best_epoch = min(hist, key=lambda h: h["valid_loss"])
+    log(f"[ours] valid acc {best_epoch['valid_acc']:.4f}, "
+        f"test acc {test['test_acc']:.4f} ({time.monotonic() - t0:.0f}s)")
+    return {"valid_acc": best_epoch["valid_acc"],
+            "test_acc": test["test_acc"],
+            "train_acc_final": hist[-1]["train_acc"],
+            "seconds": time.monotonic() - t0}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--dataset", default=None,
+                   help="mnist|fashion_mnist|synthetic (default: mnist if "
+                        "raw files exist under --data-path, else synthetic)")
+    p.add_argument("--data-path", default="./data")
+    p.add_argument("--epochs", type=int, default=2)  # ref config.py:38
+    p.add_argument("--batch", type=int, default=64)  # ref config.py:40
+    p.add_argument("--seed", type=int, default=1234)  # ref config.py:44
+    p.add_argument("--rsl", default="/tmp/parity_rsl")
+    p.add_argument("--train-limit", type=int, default=0,
+                   help="cap reference-side train samples/epoch (torch-CPU "
+                        "wall-clock control; 0 = full split)")
+    p.add_argument("--skip-ours", action="store_true")
+    p.add_argument("--skip-reference", action="store_true")
+    args = p.parse_args()
+    if args.epochs < 1:
+        p.error("--epochs must be >= 1")
+
+    dataset = args.dataset
+    if dataset is None:
+        from distributedpytorch_tpu.data import io
+        try:
+            io.load_mnist_like(args.data_path, "MNIST")
+            dataset = "mnist"
+        except FileNotFoundError:
+            log("no real MNIST under --data-path; using the synthetic "
+                "corpus (fetch real files with scripts/fetch_mnist.sh)")
+            dataset = "synthetic"
+
+    ds = load_corpus(dataset, args.data_path, args.seed)
+    ours = (None if args.skip_ours else
+            run_ours(dataset, args.data_path, args.epochs, args.batch,
+                     args.seed, args.rsl, args.train_limit))
+    ref = (None if args.skip_reference else
+           run_reference(ds, args.epochs, args.batch, args.seed,
+                         args.train_limit))
+
+    out = {"dataset": dataset, "epochs": args.epochs, "batch": args.batch,
+           "seed": args.seed, "train_limit": args.train_limit,
+           "ours": ours, "reference": ref}
+    if ours and ref:
+        out["test_acc_delta"] = round(ours["test_acc"] - ref["test_acc"], 4)
+        log(f"| {dataset} ({args.epochs} epochs, batch {args.batch}) "
+            f"| ours {ours['test_acc'] * 100:.2f}% "
+            f"| reference {ref['test_acc'] * 100:.2f}% "
+            f"| delta {out['test_acc_delta'] * 100:+.2f}pp |")
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

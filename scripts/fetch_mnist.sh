@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Fetch the raw MNIST / FashionMNIST IDX files into DATA_DIR, laid out the
+# way torchvision (and therefore this framework's IDX reader,
+# distributedpytorch_tpu/data/io.py) expects:
+#
+#   $DATA_DIR/MNIST/raw/{train,t10k}-{images-idx3,labels-idx1}-ubyte
+#   $DATA_DIR/FashionMNIST/raw/...
+#
+# Usage:  scripts/fetch_mnist.sh [DATA_DIR]           (default: ./data)
+#
+# This environment has no network egress, so the script cannot run here —
+# it documents the exact fetch for any machine that has egress.  Sources
+# are the standard public mirrors (yann.lecun.com is rate-limited; the
+# Google CVDF mirror hosts identical files).
+set -euo pipefail
+
+DATA_DIR="${1:-./data}"
+MNIST_URL="https://storage.googleapis.com/cvdf-datasets/mnist"
+FASHION_URL="http://fashion-mnist.s3-website.eu-central-1.amazonaws.com"
+
+fetch() { # fetch <base_url> <out_dir>
+  local base="$1" out="$2" f
+  mkdir -p "$out"
+  for f in train-images-idx3-ubyte train-labels-idx1-ubyte \
+           t10k-images-idx3-ubyte t10k-labels-idx1-ubyte; do
+    [ -f "$out/$f" ] && { echo "have $out/$f"; continue; }
+    echo "fetching $base/$f.gz"
+    curl -fsSL "$base/$f.gz" -o "$out/$f.gz"
+    gunzip -f "$out/$f.gz"
+  done
+}
+
+fetch "$MNIST_URL" "$DATA_DIR/MNIST/raw"
+fetch "$FASHION_URL" "$DATA_DIR/FashionMNIST/raw"
+echo "done: $DATA_DIR"

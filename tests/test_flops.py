@@ -1,0 +1,57 @@
+"""FLOP accounting (ops/flops.py): pinned against hand-computed counts for
+the flagship models and torchvision's published number for resnet18."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributedpytorch_tpu.models import get_model
+from distributedpytorch_tpu.ops import flops
+
+
+def _params(model, size):
+    x = jnp.zeros((1, size, size, 3), jnp.float32)
+    v = model.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    return v["params"], v.get("batch_stats", {})
+
+
+def test_small_cnn_flops_match_hand_count():
+    model = get_model("cnn", 10, half_precision=False)
+    p, bs = _params(model, 28)
+    got = flops.forward_flops(model, p, bs, batch=1, input_size=28)
+    # conv 3->32@28^2 + 32->32@28^2 + 32->64@14^2 + 64->64@14^2
+    # + dense 3136->256 + 256->10, all as 2*MACs
+    expect = (2 * 9 * 3 * 32 * 784 + 2 * 9 * 32 * 32 * 784
+              + 2 * 9 * 32 * 64 * 196 + 2 * 9 * 64 * 64 * 196
+              + 2 * 3136 * 256 + 2 * 256 * 10)
+    assert got == expect, (got, expect)
+    # scales linearly with batch
+    got64 = flops.forward_flops(model, p, bs, batch=64, input_size=28)
+    assert got64 == 64 * expect
+
+
+def test_mlp_flops_match_hand_count():
+    model = get_model("mlp", 10, half_precision=False)
+    p, bs = _params(model, 28)
+    got = flops.forward_flops(model, p, bs, batch=1, input_size=28)
+    expect = 2 * (28 * 28 * 3) * 512 + 2 * 512 * 256 + 2 * 256 * 10
+    assert got == expect, (got, expect)
+
+
+def test_resnet18_flops_near_published():
+    """resnet18 @224 is published at 1.814 GMACs (torchvision's table);
+    in the 2xMACs FLOP convention that is 3.628 GFLOPs — the analytic
+    count over our Flax module must land within 5%."""
+    model = get_model("resnet", 10, half_precision=False)
+    p, bs = _params(model, 224)
+    got = flops.forward_flops(model, p, bs, batch=1, input_size=224)
+    assert abs(got - 2 * 1.814e9) / (2 * 1.814e9) < 0.05, got
+
+
+def test_train_flops_is_3x_forward():
+    model = get_model("mlp", 10, half_precision=False)
+    p, bs = _params(model, 28)
+    fwd = flops.forward_flops(model, p, bs, batch=8, input_size=28)
+    per_sample = flops.train_flops_per_sample(model, p, bs, batch=8,
+                                              input_size=28)
+    np.testing.assert_allclose(per_sample, 3 * fwd / 8)

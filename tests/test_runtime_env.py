@@ -1,0 +1,29 @@
+"""runtime._multihost_env: rendezvous must trigger on Cloud TPU pod
+markers, not only on our own coordinator vars (VERDICT r1 weak #7)."""
+
+from distributedpytorch_tpu import runtime
+
+
+def test_no_markers_means_single_host(monkeypatch):
+    for v in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+              "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES"):
+        monkeypatch.delenv(v, raising=False)
+    assert not runtime._multihost_env()
+
+
+def test_explicit_coordinator_vars(monkeypatch):
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+    assert runtime._multihost_env()
+
+
+def test_pod_hostname_list(monkeypatch):
+    for v in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+              "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(v, raising=False)
+    # single-host TPU VM: one entry -> NOT multi-host
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "t1v-n-abc-w-0")
+    assert not runtime._multihost_env()
+    # pod slice: several workers -> multi-host
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "w-0,w-1,w-2,w-3")
+    assert runtime._multihost_env()
