@@ -49,7 +49,7 @@ DEBUG_SUBSET = 200          # ref dataloader.py:141
 
 MODEL_CHOICES = (
     "cnn", "mlp", "resnet", "alexnet", "vgg", "squeezenet", "densenet",
-    "inception",
+    "inception", "vit",
 )
 OPTIMIZER_CHOICES = ("adam", "SGD")
 LOSS_CHOICES = ("cross_entropy", "weighted_cross_entropy", "focal_loss")

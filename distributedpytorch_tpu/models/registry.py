@@ -19,6 +19,7 @@ from .resnet import resnet18
 from .simple import MLP, SmallCNN
 from .squeezenet import SqueezeNet
 from .vgg import VGG11BN
+from .vit import ViT
 
 MODEL_REGISTRY: Dict[str, Callable[..., nn.Module]] = {
     "cnn": lambda n, d: SmallCNN(num_classes=n, dtype=d),
@@ -29,13 +30,16 @@ MODEL_REGISTRY: Dict[str, Callable[..., nn.Module]] = {
     "squeezenet": lambda n, d: SqueezeNet(num_classes=n, dtype=d),  # :69-76
     "densenet": lambda n, d: densenet121(n, d),      # :78-85
     "inception": lambda n, d: InceptionV3(num_classes=n, dtype=d),  # :87-99
+    # Framework addition beyond the reference zoo (which is CNN-only):
+    # the attention model family, see models/vit.py + ops/attention.py.
+    "vit": lambda n, d: ViT(num_classes=n, dtype=d),
 }
 
 # name -> input resolution (ref getModelInputSize, utils.py:24-36: 224 for
-# all but inception=299; cnn/mlp run at the dataset-native 28).
+# all but inception=299; cnn/mlp/vit run at the dataset-native 28).
 _INPUT_SIZES = {
     "cnn": 28, "mlp": 28, "resnet": 224, "alexnet": 224, "vgg": 224,
-    "squeezenet": 224, "densenet": 224, "inception": 299,
+    "squeezenet": 224, "densenet": 224, "inception": 299, "vit": 28,
 }
 
 # Models whose train-mode forward also returns auxiliary logits

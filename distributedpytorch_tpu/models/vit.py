@@ -1,0 +1,94 @@
+"""ViT-style patch-transformer classifier — the framework's attention
+model family.
+
+The reference zoo is CNN-only (ref utils.py:38-105); this model is
+framework-added capability and the consumer of the sequence-parallel
+attention in ops/attention.py.  Built TPU-first:
+
+  * patch embedding is a strided conv (one im2col matmul on the MXU);
+  * pre-LN transformer blocks with GELU MLPs — all dense matmuls,
+    bfloat16 compute / float32 params like the rest of the zoo;
+  * mean-pool over tokens (no CLS token: one less ragged concat to shard),
+    classifier uniformly named ``head`` so feature-extract freezing and
+    head replacement work exactly like every other zoo model;
+  * ``attention_fn`` is injectable: the default is the standard fused
+    softmax attention (XLA's flash kernels on TPU); passing a closure over
+    ``ops.attention.ring_attention`` runs the same model sequence-parallel
+    for sequences too long for one device (tests/test_attention.py pins
+    the two paths equal).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.attention import full_attention
+
+AttentionFn = Callable[..., jnp.ndarray]  # (q, k, v) -> out, all (B,S,H,D)
+
+
+class TransformerBlock(nn.Module):
+    dim: int
+    heads: int
+    mlp_ratio: int
+    dtype: Any
+    attention_fn: AttentionFn
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b, s, _ = x.shape
+        head_dim = self.dim // self.heads
+
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, self.heads, head_dim)
+        k = k.reshape(b, s, self.heads, head_dim)
+        v = v.reshape(b, s, self.heads, head_dim)
+        attn = self.attention_fn(q, k, v).reshape(b, s, self.dim)
+        x = x + nn.Dense(self.dim, dtype=self.dtype, name="proj")(attn)
+
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype,
+                     name="mlp_up")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.dim, dtype=self.dtype, name="mlp_down")(h)
+        return x + h
+
+
+class ViT(nn.Module):
+    """Small vision transformer; defaults size it for 28x28 inputs
+    (patch 4 -> 49 tokens) at ~1.6M params."""
+
+    num_classes: int = 10
+    patch: int = 4
+    dim: int = 128
+    depth: int = 4
+    heads: int = 4
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        attn_fn = self.attention_fn or full_attention
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.dim, (self.patch, self.patch),
+                    strides=(self.patch, self.patch), padding="VALID",
+                    dtype=self.dtype, name="patch_embed")(x)
+        b, gh, gw, c = x.shape
+        x = x.reshape(b, gh * gw, c)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, gh * gw, self.dim), jnp.float32)
+        x = x + pos.astype(self.dtype)
+        for i in range(self.depth):
+            x = TransformerBlock(self.dim, self.heads, self.mlp_ratio,
+                                 self.dtype, attn_fn,
+                                 name=f"block{i}")(x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        x = jnp.mean(x, axis=1)  # mean-pool tokens
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)

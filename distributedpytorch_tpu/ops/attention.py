@@ -1,0 +1,160 @@
+"""Attention ops: full (XLA-fused) and ring (sequence-parallel) attention.
+
+The reference has no attention or sequence models at all (SURVEY §2:
+image CNNs only — this module is framework-added capability, built
+TPU-first): long sequences are sharded along the mesh's 'model' axis and
+attended with RING attention — each device holds its local Q/K/V sequence
+block, K/V blocks rotate around the ring via `lax.ppermute` (ICI
+neighbor-to-neighbor traffic, the topology TPUs are built for), and
+softmax is accumulated streamingly with the flash-attention
+log-sum-exp merge, so the full S x S score matrix never materializes and
+per-device memory stays O(S_local).
+
+`ring_attention` is written against named axes (`shard_map`); numerics —
+outputs AND gradients — are pinned to `full_attention` in
+tests/test_attention.py on the 8-device virtual mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   causal: bool = False) -> jax.Array:
+    """Reference scaled-dot-product attention.
+
+    q/k/v: (B, S, H, D).  Computed in float32 for a stable softmax, cast
+    back to the input dtype (the matmuls still feed the MXU in bf16 when
+    inputs are bf16 — XLA keeps the mixed-precision contraction).
+    """
+    dtype = q.dtype
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(dtype)
+
+
+# Finite "masked" sentinel: keeps every exp()/subtraction finite so both
+# the forward AND the backward pass are NaN-free (a -inf sentinel turns
+# exp(-inf - -inf) into NaN for not-yet-attended rows).
+_MASKED = -1e30
+
+
+def _ring_body(carry, _, *, axis_name: str, n_dev: int, scale: float,
+               q_pos, causal: bool):
+    """One ring step: attend local Q against the currently-held K/V block,
+    merge into the running flash accumulator, rotate K/V (+ positions) to
+    the next device."""
+    k_cur, v_cur, k_pos, acc, m, l = carry
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q_pos[1], k_cur) * scale
+    if causal:
+        mask = (q_pos[0][:, None] >= k_pos[None, :])[None, None]
+        scores = jnp.where(mask, scores, _MASKED)
+
+    m_blk = jnp.max(scores, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    if causal:
+        p = jnp.where(mask, p, 0.0)  # masked entries contribute exactly 0
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    acc_new = (acc * alpha[..., None]
+               + jnp.einsum("bhqk,bkhd->bhqd", p, v_cur))
+
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+    k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+    v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+    kp_next = jax.lax.ppermute(k_pos, axis_name, perm)
+    return (k_next, v_next, kp_next, acc_new, m_new, l_new), None
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, n_dev: int,
+                          s_local: int, causal: bool):
+    """Per-device body (runs under shard_map): q/k/v are the LOCAL blocks
+    (B, S_local, H, D); returns the local output block."""
+    dtype = q.dtype
+    b, s, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    idx = jax.lax.axis_index(axis_name)
+    q_glob = idx * s_local + jnp.arange(s_local)
+    k_pos = q_glob  # initially each device holds its own block
+
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+
+    # Initial accumulators are derived from qf (not fresh constants) so
+    # they carry the same varying-over-mesh-axes type as the loop outputs
+    # — lax.scan under shard_map requires carry in/out types to match.
+    qt = jnp.einsum("bqhd->bhqd", qf)
+    acc = qt * 0.0
+    m = qt[..., 0] * 0.0 + _MASKED
+    l = qt[..., 0] * 0.0
+
+    body = functools.partial(_ring_body, axis_name=axis_name, n_dev=n_dev,
+                             scale=scale, q_pos=(q_glob, qf), causal=causal)
+    (_, _, _, acc, m, l), _ = jax.lax.scan(
+        body, (kf, vf, k_pos, acc, m, l), None, length=n_dev)
+
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.einsum("bhqd->bqhd", out).astype(dtype)
+
+
+def _seq_spec(mesh: Mesh, axis_name: str) -> P:
+    """(B, S, H, D) partition spec: S over the sequence axis, B over the
+    single remaining data axis when there is exactly one."""
+    data_axes = tuple(a for a in mesh.axis_names if a != axis_name)
+    batch_spec = data_axes[0] if len(data_axes) == 1 else None
+    return P(batch_spec, axis_name, None, None)
+
+
+@functools.lru_cache(maxsize=32)
+def _ring_jitted(mesh: Mesh, axis_name: str, n_dev: int, s_local: int,
+                 causal: bool):
+    spec = _seq_spec(mesh, axis_name)
+    fn = functools.partial(_ring_attention_local, axis_name=axis_name,
+                           n_dev=n_dev, s_local=s_local, causal=causal)
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                   axis_name: str = "model", causal: bool = False,
+                   ) -> jax.Array:
+    """Sequence-parallel attention over `mesh`'s `axis_name` axis.
+
+    q/k/v: GLOBAL (B, S, H, D) arrays with S sharded over `axis_name`
+    (other axes replicated/data-sharded as the caller likes along 'data').
+    Exact same math as `full_attention` — the flash merge is numerically
+    stable and the ring visits every K/V block exactly once.  Communication
+    is 2 x (S/n) x H x D per step x n steps of neighbor `ppermute` — the
+    all-to-all-free pattern that rides ICI neighbor links.
+
+    The jitted shard_map program is cached on (mesh, axis, shape, causal),
+    so repeated calls (e.g. every ViT block, every step) are cache hits.
+    """
+    n_dev = mesh.shape[axis_name]
+    s = q.shape[1]
+    if s % n_dev:
+        raise ValueError(f"sequence length {s} not divisible by "
+                         f"{axis_name} axis size {n_dev}")
+    return _ring_jitted(mesh, axis_name, n_dev, s // n_dev, causal)(q, k, v)
+
+
+def sequence_sharding(mesh: Mesh, axis_name: str = "model"
+                      ) -> NamedSharding:
+    """Sharding for (B, S, H, D) activations: S over the sequence axis,
+    B over 'data' when present."""
+    return NamedSharding(mesh, _seq_spec(mesh, axis_name))

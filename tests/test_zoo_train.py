@@ -25,7 +25,7 @@ from distributedpytorch_tpu.train.engine import Engine, make_optimizer
 # (enforced with a trace-time error — see models/inception.py AuxHead).
 _TEST_SIZES = {
     "cnn": 28, "mlp": 28, "resnet": 64, "alexnet": 64, "vgg": 64,
-    "squeezenet": 64, "densenet": 64, "inception": 299,
+    "squeezenet": 64, "densenet": 64, "inception": 299, "vit": 28,
 }
 
 
