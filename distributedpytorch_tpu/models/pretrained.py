@@ -17,7 +17,9 @@ param/batch_stats trees, leaving the freshly-initialized ``head`` in place
   * BatchNorm weight/bias          -> scale/bias (params)
     running_mean/running_var       -> mean/var  (batch_stats)
 
-Supported: resnet18, alexnet, vgg11_bn.  Unsupported architectures RAISE —
+Supported: all six reference architectures — resnet18, alexnet, vgg11_bn,
+squeezenet1_0, densenet121, inception_v3 (both inception fc heads stay
+fresh, ref utils.py:93-98).  Unsupported architectures RAISE —
 ``use_pretrained=True`` must never silently no-op.
 """
 
@@ -27,7 +29,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-SUPPORTED = ("resnet", "alexnet", "vgg")
+SUPPORTED = ("resnet", "alexnet", "vgg", "squeezenet", "densenet",
+             "inception")
 
 
 def _t_conv(w) -> np.ndarray:
@@ -124,10 +127,130 @@ def _convert_vgg11_bn(sd: Dict[str, Any]):
     return params, stats
 
 
+def _convert_squeezenet(sd: Dict[str, Any]):
+    """torchvision squeezenet1_0: features.0 stem conv; Fire modules at
+    features.{3,4,5,7,8,9,10,12} with squeeze/expand1x1/expand3x3 convs
+    (classifier.1 is the replaced head, ref utils.py:74)."""
+    def conv(prefix):
+        return {"kernel": _t_conv(sd[f"{prefix}.weight"]),
+                "bias": _vec(sd[f"{prefix}.bias"])}
+
+    params: Dict[str, Any] = {"Conv_0": conv("features.0")}
+    for i, t in enumerate((3, 4, 5, 7, 8, 9, 10, 12)):
+        params[f"Fire_{i}"] = {
+            "Conv_0": conv(f"features.{t}.squeeze"),
+            "Conv_1": conv(f"features.{t}.expand1x1"),
+            "Conv_2": conv(f"features.{t}.expand3x3"),
+        }
+    return params, {}
+
+
+def _convert_densenet121(sd: Dict[str, Any]):
+    """torchvision densenet121: conv0/norm0 stem; denseblock{1..4} of
+    denselayer{n} (norm1/conv1/norm2/conv2); transition{1..3} (norm/conv);
+    norm5 (classifier is the replaced head, ref utils.py:83-84).
+
+    Flax numbering: DenseLayer_{0..57} run cumulatively across blocks;
+    transitions are the top-level BatchNorm_{1..3}/Conv_{1..3}; the final
+    norm is BatchNorm_4."""
+    params: Dict[str, Any] = {
+        "Conv_0": {"kernel": _t_conv(sd["features.conv0.weight"])}}
+    stats: Dict[str, Any] = {}
+    params["BatchNorm_0"], stats["BatchNorm_0"] = _bn(sd, "features.norm0")
+    li = 0
+    block_config = (6, 12, 24, 16)
+    for b, n_layers in enumerate(block_config, start=1):
+        for n in range(1, n_layers + 1):
+            t = f"features.denseblock{b}.denselayer{n}"
+            lp: Dict[str, Any] = {}
+            ls: Dict[str, Any] = {}
+            lp["BatchNorm_0"], ls["BatchNorm_0"] = _bn(sd, f"{t}.norm1")
+            lp["Conv_0"] = {"kernel": _t_conv(sd[f"{t}.conv1.weight"])}
+            lp["BatchNorm_1"], ls["BatchNorm_1"] = _bn(sd, f"{t}.norm2")
+            lp["Conv_1"] = {"kernel": _t_conv(sd[f"{t}.conv2.weight"])}
+            params[f"DenseLayer_{li}"] = lp
+            stats[f"DenseLayer_{li}"] = ls
+            li += 1
+        if b < len(block_config):
+            t = f"features.transition{b}"
+            params[f"BatchNorm_{b}"], stats[f"BatchNorm_{b}"] = _bn(
+                sd, f"{t}.norm")
+            params[f"Conv_{b}"] = {"kernel": _t_conv(sd[f"{t}.conv.weight"])}
+    params["BatchNorm_4"], stats["BatchNorm_4"] = _bn(sd, "features.norm5")
+    return params, stats
+
+
+def _basic_conv(sd: Dict[str, Any], prefix: str):
+    """torchvision BasicConv2d (conv bias-free + bn) -> our BasicConv
+    submodule trees."""
+    p = {"Conv_0": {"kernel": _t_conv(sd[f"{prefix}.conv.weight"])}}
+    p["BatchNorm_0"], bn_stats = _bn(sd, f"{prefix}.bn")
+    return p, {"BatchNorm_0": bn_stats}
+
+
+# Branch creation order inside each Flax Inception{A..E} module == the
+# torchvision submodule order (models/inception.py mirrors it).
+_INCEPTION_BRANCHES = {
+    "A": ("branch1x1", "branch5x5_1", "branch5x5_2", "branch3x3dbl_1",
+          "branch3x3dbl_2", "branch3x3dbl_3", "branch_pool"),
+    "B": ("branch3x3", "branch3x3dbl_1", "branch3x3dbl_2", "branch3x3dbl_3"),
+    "C": ("branch1x1", "branch7x7_1", "branch7x7_2", "branch7x7_3",
+          "branch7x7dbl_1", "branch7x7dbl_2", "branch7x7dbl_3",
+          "branch7x7dbl_4", "branch7x7dbl_5", "branch_pool"),
+    "D": ("branch3x3_1", "branch3x3_2", "branch7x7x3_1", "branch7x7x3_2",
+          "branch7x7x3_3", "branch7x7x3_4"),
+    "E": ("branch1x1", "branch3x3_1", "branch3x3_2a", "branch3x3_2b",
+          "branch3x3dbl_1", "branch3x3dbl_2", "branch3x3dbl_3a",
+          "branch3x3dbl_3b", "branch_pool"),
+}
+
+_INCEPTION_MIXED = (
+    ("InceptionA_0", "Mixed_5b", "A"), ("InceptionA_1", "Mixed_5c", "A"),
+    ("InceptionA_2", "Mixed_5d", "A"), ("InceptionB_0", "Mixed_6a", "B"),
+    ("InceptionC_0", "Mixed_6b", "C"), ("InceptionC_1", "Mixed_6c", "C"),
+    ("InceptionC_2", "Mixed_6d", "C"), ("InceptionC_3", "Mixed_6e", "C"),
+    ("InceptionD_0", "Mixed_7a", "D"), ("InceptionE_0", "Mixed_7b", "E"),
+    ("InceptionE_1", "Mixed_7c", "E"),
+)
+
+
+def _convert_inception_v3(sd: Dict[str, Any]):
+    """torchvision inception_v3 (aux_logits=True): stem Conv2d_* BasicConvs,
+    Mixed_5b..7c blocks, AuxLogits conv0/conv1.  BOTH fc heads (fc and
+    AuxLogits.fc) stay fresh — the reference replaces both
+    (ref utils.py:93-98)."""
+    params: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+    stem = ("Conv2d_1a_3x3", "Conv2d_2a_3x3", "Conv2d_2b_3x3",
+            "Conv2d_3b_1x1", "Conv2d_4a_3x3")
+    for i, t in enumerate(stem):
+        params[f"BasicConv_{i}"], stats[f"BasicConv_{i}"] = _basic_conv(sd, t)
+    for flax_name, torch_name, kind in _INCEPTION_MIXED:
+        mp: Dict[str, Any] = {}
+        ms: Dict[str, Any] = {}
+        for i, branch in enumerate(_INCEPTION_BRANCHES[kind]):
+            mp[f"BasicConv_{i}"], ms[f"BasicConv_{i}"] = _basic_conv(
+                sd, f"{torch_name}.{branch}")
+        params[flax_name] = mp
+        stats[flax_name] = ms
+    aux_p: Dict[str, Any] = {}
+    aux_s: Dict[str, Any] = {}
+    aux_p["BasicConv_0"], aux_s["BasicConv_0"] = _basic_conv(
+        sd, "AuxLogits.conv0")
+    aux_p["BasicConv_1"], aux_s["BasicConv_1"] = _basic_conv(
+        sd, "AuxLogits.conv1")
+    params["AuxHead_0"] = aux_p
+    stats["AuxHead_0"] = aux_s
+    return params, stats
+
+
 _CONVERTERS = {
     "resnet": _convert_resnet18,
     "alexnet": _convert_alexnet,
     "vgg": _convert_vgg11_bn,
+    "squeezenet": _convert_squeezenet,
+    "densenet": _convert_densenet121,
+    "inception": _convert_inception_v3,
 }
 
 

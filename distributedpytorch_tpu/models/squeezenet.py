@@ -4,6 +4,17 @@ Fire modules (squeeze 1x1 -> expand 1x1 + 3x3 concat); the classifier is a
 dropout + 1x1 conv to ``num_classes`` + ReLU + global average pool — the
 conv is exactly the layer the reference replaces (ref utils.py:74), named
 ``head`` here.
+
+Max-pools replicate torchvision's ``ceil_mode=True`` (MaxPool2d(3, 2,
+ceil_mode=True)): when (dim - 3) is odd the window grid is padded one
+element on the bottom/right, so feature-map sizes — and therefore converted
+pretrained weights' activations — match torchvision exactly (e.g. 54 -> 27,
+not 26, at the second pool on a 224 input).
+
+Compatibility note: this geometry (VALID stem + ceil pools) replaced an
+earlier SAME-stem/floor-pool variant; param shapes are identical, so a
+checkpoint from the old variant still loads but its activations flow
+through a shifted grid.  No released checkpoint predates the fix.
 """
 
 from __future__ import annotations
@@ -12,6 +23,16 @@ from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp
+
+
+def _max_pool_ceil(x, window: int = 3, stride: int = 2):
+    """torchvision MaxPool2d(window, stride, ceil_mode=True)."""
+    pads = []
+    for dim in (x.shape[1], x.shape[2]):
+        rem = (dim - window) % stride
+        pads.append((0, (stride - rem) % stride if rem else 0))
+    return nn.max_pool(x, (window, window), strides=(stride, stride),
+                       padding=pads)
 
 
 class Fire(nn.Module):
@@ -36,17 +57,18 @@ class SqueezeNet(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        x = nn.relu(nn.Conv(96, (7, 7), strides=(2, 2), dtype=self.dtype)(x))
-        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(nn.Conv(96, (7, 7), strides=(2, 2), padding="VALID",
+                            dtype=self.dtype)(x))
+        x = _max_pool_ceil(x)
         x = Fire(16, 64, 64, self.dtype)(x)
         x = Fire(16, 64, 64, self.dtype)(x)
         x = Fire(32, 128, 128, self.dtype)(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = _max_pool_ceil(x)
         x = Fire(32, 128, 128, self.dtype)(x)
         x = Fire(48, 192, 192, self.dtype)(x)
         x = Fire(48, 192, 192, self.dtype)(x)
         x = Fire(64, 256, 256, self.dtype)(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = _max_pool_ceil(x)
         x = Fire(64, 256, 256, self.dtype)(x)
         x = nn.Dropout(0.5, deterministic=not train)(x)
         x = nn.relu(nn.Conv(self.num_classes, (1, 1), dtype=self.dtype,

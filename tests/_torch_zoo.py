@@ -126,3 +126,281 @@ def randomize_bn_stats(model: nn.Module, seed: int = 0) -> None:
                                              generator=g) * 0.1)
             m.running_var.copy_(
                 torch.rand(m.running_var.shape, generator=g) * 0.5 + 0.75)
+
+
+class _TorchFire(nn.Module):
+    def __init__(self, cin, s, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2d(cin, s, 1)
+        self.squeeze_activation = nn.ReLU(inplace=True)
+        self.expand1x1 = nn.Conv2d(s, e1, 1)
+        self.expand1x1_activation = nn.ReLU(inplace=True)
+        self.expand3x3 = nn.Conv2d(s, e3, 3, padding=1)
+        self.expand3x3_activation = nn.ReLU(inplace=True)
+
+    def forward(self, x):
+        x = self.squeeze_activation(self.squeeze(x))
+        return torch.cat([self.expand1x1_activation(self.expand1x1(x)),
+                          self.expand3x3_activation(self.expand3x3(x))], 1)
+
+
+class TorchSqueezeNet(nn.Module):
+    """torchvision.models.squeezenet1_0 topology + key names."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2d(3, 96, 7, 2), nn.ReLU(inplace=True),
+            nn.MaxPool2d(3, 2, ceil_mode=True),
+            _TorchFire(96, 16, 64, 64), _TorchFire(128, 16, 64, 64),
+            _TorchFire(128, 32, 128, 128),
+            nn.MaxPool2d(3, 2, ceil_mode=True),
+            _TorchFire(256, 32, 128, 128), _TorchFire(256, 48, 192, 192),
+            _TorchFire(384, 48, 192, 192), _TorchFire(384, 64, 256, 256),
+            nn.MaxPool2d(3, 2, ceil_mode=True),
+            _TorchFire(512, 64, 256, 256))
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Conv2d(512, num_classes, 1),
+            nn.ReLU(inplace=True), nn.AdaptiveAvgPool2d((1, 1)))
+
+    def forward(self, x):
+        return torch.flatten(self.classifier(self.features(x)), 1)
+
+
+class _TorchDenseLayer(nn.Module):
+    def __init__(self, cin, growth, bn_size):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2d(cin)
+        self.relu1 = nn.ReLU(inplace=True)
+        self.conv1 = nn.Conv2d(cin, bn_size * growth, 1, bias=False)
+        self.norm2 = nn.BatchNorm2d(bn_size * growth)
+        self.relu2 = nn.ReLU(inplace=True)
+        self.conv2 = nn.Conv2d(bn_size * growth, growth, 3, padding=1,
+                               bias=False)
+
+    def forward(self, x):
+        y = self.conv1(self.relu1(self.norm1(x)))
+        y = self.conv2(self.relu2(self.norm2(y)))
+        return torch.cat([x, y], 1)
+
+
+class _TorchTransition(nn.Module):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.norm = nn.BatchNorm2d(cin)
+        self.relu = nn.ReLU(inplace=True)
+        self.conv = nn.Conv2d(cin, cout, 1, bias=False)
+        self.pool = nn.AvgPool2d(2, 2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+class TorchDenseNet121(nn.Module):
+    """torchvision.models.densenet121 topology + key names."""
+
+    def __init__(self, num_classes=10, growth=32, block_config=(6, 12, 24, 16),
+                 init_features=64, bn_size=4):
+        super().__init__()
+        from collections import OrderedDict
+        self.features = nn.Sequential(OrderedDict([
+            ("conv0", nn.Conv2d(3, init_features, 7, 2, 3, bias=False)),
+            ("norm0", nn.BatchNorm2d(init_features)),
+            ("relu0", nn.ReLU(inplace=True)),
+            ("pool0", nn.MaxPool2d(3, 2, 1))]))
+        ch = init_features
+        for b, n_layers in enumerate(block_config):
+            block = nn.Module()
+            for i in range(n_layers):
+                block.add_module(f"denselayer{i + 1}",
+                                 _TorchDenseLayer(ch + i * growth, growth,
+                                                  bn_size))
+            # give the block a forward so the whole net runs
+            def _block_forward(self_block, x):
+                for m in self_block.children():
+                    x = m(x)
+                return x
+            block.forward = _block_forward.__get__(block)
+            self.features.add_module(f"denseblock{b + 1}", block)
+            ch += n_layers * growth
+            if b != len(block_config) - 1:
+                self.features.add_module(f"transition{b + 1}",
+                                         _TorchTransition(ch, ch // 2))
+                ch //= 2
+        self.features.add_module("norm5", nn.BatchNorm2d(ch))
+        self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = torch.relu(self.features(x))
+        x = torch.nn.functional.adaptive_avg_pool2d(x, (1, 1))
+        return self.classifier(torch.flatten(x, 1))
+
+
+class _TBC(nn.Module):
+    """torchvision BasicConv2d: conv(bias=False) + bn(eps=1e-3)."""
+
+    def __init__(self, cin, cout, **kw):
+        super().__init__()
+        self.conv = nn.Conv2d(cin, cout, bias=False, **kw)
+        self.bn = nn.BatchNorm2d(cout, eps=0.001)
+
+    def forward(self, x):
+        return torch.relu(self.bn(self.conv(x)))
+
+
+class _TIncA(nn.Module):
+    def __init__(self, cin, pool_features):
+        super().__init__()
+        self.branch1x1 = _TBC(cin, 64, kernel_size=1)
+        self.branch5x5_1 = _TBC(cin, 48, kernel_size=1)
+        self.branch5x5_2 = _TBC(48, 64, kernel_size=5, padding=2)
+        self.branch3x3dbl_1 = _TBC(cin, 64, kernel_size=1)
+        self.branch3x3dbl_2 = _TBC(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = _TBC(96, 96, kernel_size=3, padding=1)
+        self.branch_pool = _TBC(cin, pool_features, kernel_size=1)
+
+    def forward(self, x):
+        p = torch.nn.functional.avg_pool2d(x, 3, 1, 1)
+        return torch.cat([
+            self.branch1x1(x), self.branch5x5_2(self.branch5x5_1(x)),
+            self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x))),
+            self.branch_pool(p)], 1)
+
+
+class _TIncB(nn.Module):
+    def __init__(self, cin):
+        super().__init__()
+        self.branch3x3 = _TBC(cin, 384, kernel_size=3, stride=2)
+        self.branch3x3dbl_1 = _TBC(cin, 64, kernel_size=1)
+        self.branch3x3dbl_2 = _TBC(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = _TBC(96, 96, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        return torch.cat([
+            self.branch3x3(x),
+            self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x))),
+            torch.nn.functional.max_pool2d(x, 3, 2)], 1)
+
+
+class _TIncC(nn.Module):
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.branch1x1 = _TBC(cin, 192, kernel_size=1)
+        self.branch7x7_1 = _TBC(cin, c7, kernel_size=1)
+        self.branch7x7_2 = _TBC(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7_3 = _TBC(c7, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_1 = _TBC(cin, c7, kernel_size=1)
+        self.branch7x7dbl_2 = _TBC(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_3 = _TBC(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7dbl_4 = _TBC(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_5 = _TBC(c7, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch_pool = _TBC(cin, 192, kernel_size=1)
+
+    def forward(self, x):
+        b7 = self.branch7x7_3(self.branch7x7_2(self.branch7x7_1(x)))
+        bd = self.branch7x7dbl_1(x)
+        bd = self.branch7x7dbl_3(self.branch7x7dbl_2(bd))
+        bd = self.branch7x7dbl_5(self.branch7x7dbl_4(bd))
+        p = torch.nn.functional.avg_pool2d(x, 3, 1, 1)
+        return torch.cat([self.branch1x1(x), b7, bd, self.branch_pool(p)], 1)
+
+
+class _TIncD(nn.Module):
+    def __init__(self, cin):
+        super().__init__()
+        self.branch3x3_1 = _TBC(cin, 192, kernel_size=1)
+        self.branch3x3_2 = _TBC(192, 320, kernel_size=3, stride=2)
+        self.branch7x7x3_1 = _TBC(cin, 192, kernel_size=1)
+        self.branch7x7x3_2 = _TBC(192, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7x3_3 = _TBC(192, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7x3_4 = _TBC(192, 192, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        b7 = self.branch7x7x3_2(self.branch7x7x3_1(x))
+        b7 = self.branch7x7x3_4(self.branch7x7x3_3(b7))
+        return torch.cat([
+            self.branch3x3_2(self.branch3x3_1(x)), b7,
+            torch.nn.functional.max_pool2d(x, 3, 2)], 1)
+
+
+class _TIncE(nn.Module):
+    def __init__(self, cin):
+        super().__init__()
+        self.branch1x1 = _TBC(cin, 320, kernel_size=1)
+        self.branch3x3_1 = _TBC(cin, 384, kernel_size=1)
+        self.branch3x3_2a = _TBC(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3_2b = _TBC(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch3x3dbl_1 = _TBC(cin, 448, kernel_size=1)
+        self.branch3x3dbl_2 = _TBC(448, 384, kernel_size=3, padding=1)
+        self.branch3x3dbl_3a = _TBC(384, 384, kernel_size=(1, 3),
+                                    padding=(0, 1))
+        self.branch3x3dbl_3b = _TBC(384, 384, kernel_size=(3, 1),
+                                    padding=(1, 0))
+        self.branch_pool = _TBC(cin, 192, kernel_size=1)
+
+    def forward(self, x):
+        b3 = self.branch3x3_1(x)
+        b3 = torch.cat([self.branch3x3_2a(b3), self.branch3x3_2b(b3)], 1)
+        bd = self.branch3x3dbl_2(self.branch3x3dbl_1(x))
+        bd = torch.cat([self.branch3x3dbl_3a(bd), self.branch3x3dbl_3b(bd)], 1)
+        p = torch.nn.functional.avg_pool2d(x, 3, 1, 1)
+        return torch.cat([self.branch1x1(x), b3, bd, self.branch_pool(p)], 1)
+
+
+class _TIncAux(nn.Module):
+    def __init__(self, cin, num_classes):
+        super().__init__()
+        self.conv0 = _TBC(cin, 128, kernel_size=1)
+        self.conv1 = _TBC(128, 768, kernel_size=5)
+        self.fc = nn.Linear(768, num_classes)
+
+    def forward(self, x):
+        x = torch.nn.functional.avg_pool2d(x, 5, 3)
+        x = self.conv1(self.conv0(x))
+        x = torch.nn.functional.adaptive_avg_pool2d(x, (1, 1))
+        return self.fc(torch.flatten(x, 1))
+
+
+class TorchInceptionV3(nn.Module):
+    """torchvision.models.inception_v3 topology + key names (eval fwd)."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.Conv2d_1a_3x3 = _TBC(3, 32, kernel_size=3, stride=2)
+        self.Conv2d_2a_3x3 = _TBC(32, 32, kernel_size=3)
+        self.Conv2d_2b_3x3 = _TBC(32, 64, kernel_size=3, padding=1)
+        self.Conv2d_3b_1x1 = _TBC(64, 80, kernel_size=1)
+        self.Conv2d_4a_3x3 = _TBC(80, 192, kernel_size=3)
+        self.Mixed_5b = _TIncA(192, 32)
+        self.Mixed_5c = _TIncA(256, 64)
+        self.Mixed_5d = _TIncA(288, 64)
+        self.Mixed_6a = _TIncB(288)
+        self.Mixed_6b = _TIncC(768, 128)
+        self.Mixed_6c = _TIncC(768, 160)
+        self.Mixed_6d = _TIncC(768, 160)
+        self.Mixed_6e = _TIncC(768, 192)
+        self.AuxLogits = _TIncAux(768, num_classes)
+        self.Mixed_7a = _TIncD(768)
+        self.Mixed_7b = _TIncE(1280)
+        self.Mixed_7c = _TIncE(2048)
+        self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        mp = torch.nn.functional.max_pool2d
+        x = self.Conv2d_2b_3x3(self.Conv2d_2a_3x3(self.Conv2d_1a_3x3(x)))
+        x = mp(x, 3, 2)
+        x = self.Conv2d_4a_3x3(self.Conv2d_3b_1x1(x))
+        x = mp(x, 3, 2)
+        x = self.Mixed_5d(self.Mixed_5c(self.Mixed_5b(x)))
+        x = self.Mixed_6e(self.Mixed_6d(self.Mixed_6c(
+            self.Mixed_6b(self.Mixed_6a(x)))))
+        x = self.Mixed_7c(self.Mixed_7b(self.Mixed_7a(x)))
+        x = torch.nn.functional.adaptive_avg_pool2d(x, (1, 1))
+        return self.fc(torch.flatten(x, 1))
+
+
+TORCH_ZOO.update({
+    "squeezenet": TorchSqueezeNet,
+    "densenet": TorchDenseNet121,
+    "inception": TorchInceptionV3,
+})

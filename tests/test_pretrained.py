@@ -34,6 +34,16 @@ def _flax_init(name, size):
     return m, v["params"], v.get("batch_stats", {})
 
 
+# Where each torch model keeps the classifier the reference replaces
+# (ref utils.py:42-99).
+_TORCH_HEAD = {
+    "resnet": lambda m: m.fc,
+    "alexnet": lambda m: m.classifier[6],
+    "vgg": lambda m: m.classifier[6],
+    "squeezenet": lambda m: m.classifier[1],  # a 1x1 Conv2d
+    "densenet": lambda m: m.classifier,
+    "inception": lambda m: m.fc,
+}
 @pytest.mark.parametrize("name", sorted(TORCH_ZOO))
 def test_converted_logits_match_torch(name):
     torch.manual_seed(42)
@@ -41,7 +51,8 @@ def test_converted_logits_match_torch(name):
     randomize_bn_stats(tmodel, seed=7)
     tmodel.eval()
 
-    size = 224
+    # the registry's own size table (224 for all, 299 for inception)
+    size = models.get_model_input_size(name)
     m, params, batch_stats = _flax_init(name, size)
     params, batch_stats = pretrained.convert_state_dict(
         name, {k: v.numpy() for k, v in tmodel.state_dict().items()},
@@ -49,10 +60,14 @@ def test_converted_logits_match_torch(name):
 
     # The head stays freshly initialized (replace-after-load semantics,
     # ref utils.py:46-48); copy it INTO the torch model for comparison.
-    head_t = tmodel.fc if name == "resnet" else tmodel.classifier[6]
+    head_t = _TORCH_HEAD[name](tmodel)
+    kernel = np.asarray(params["head"]["kernel"])
     with torch.no_grad():
-        head_t.weight.copy_(torch.from_numpy(
-            np.asarray(params["head"]["kernel"]).T))
+        if kernel.ndim == 4:  # squeezenet's conv head: HWIO -> OIHW
+            head_t.weight.copy_(
+                torch.from_numpy(kernel.transpose(3, 2, 0, 1)))
+        else:
+            head_t.weight.copy_(torch.from_numpy(kernel.T))
         head_t.bias.copy_(torch.from_numpy(
             np.asarray(params["head"]["bias"])))
 
@@ -127,3 +142,25 @@ def test_feature_extract_finetune_trains_head_only(tmp_path):
         np.asarray(state.params["Conv_0"]["kernel"]), backbone_before)
     assert not np.allclose(np.asarray(state.params["head"]["kernel"]),
                            head_before)
+
+
+def test_inception_aux_convs_converted():
+    """The aux tower is eval-invisible (train-only branch), so pin its
+    converted weights tensor-to-tensor instead."""
+    torch.manual_seed(5)
+    tmodel = TORCH_ZOO["inception"](num_classes=10)
+    _, params, stats = _flax_init("inception", 299)
+    params, stats = pretrained.convert_state_dict(
+        "inception", {k: v.numpy() for k, v in tmodel.state_dict().items()},
+        params, stats)
+    sd = tmodel.state_dict()
+    for i, t in enumerate(("conv0", "conv1")):
+        np.testing.assert_array_equal(
+            np.asarray(params["AuxHead_0"][f"BasicConv_{i}"]["Conv_0"]
+                       ["kernel"]),
+            sd[f"AuxLogits.{t}.conv.weight"].numpy().transpose(2, 3, 1, 0))
+        np.testing.assert_array_equal(
+            np.asarray(stats["AuxHead_0"][f"BasicConv_{i}"]["BatchNorm_0"]
+                       ["mean"]),
+            sd[f"AuxLogits.{t}.bn.running_mean"].numpy())
+    # the aux fc itself stays fresh (both heads replaced, ref utils.py:93-98)
