@@ -26,8 +26,11 @@ Parity notes vs torchvision:
     crop resize is bilinear; the fused warp is bilinear end-to-end, a
     per-pixel numeric divergence from the reference train transform
     (deliberate: one exact bilinear pass, better quality, MXU-friendly).
-  * All randomness flows from a single JAX key: per-image keys are derived
-    with fold_in, so results are independent of batch size and device count.
+  * All randomness flows from a single per-step JAX key: one batched
+    ``uniform(key, (b, 5))`` draw, indexed by position in the
+    deterministically-composed global batch (see _sample_affine_batch), so
+    results are independent of device count and identical between the
+    resident and streaming loaders.
 """
 
 from __future__ import annotations
@@ -46,27 +49,36 @@ LOG_RATIO_RANGE = (math.log(3.0 / 4.0), math.log(4.0 / 3.0))
 MAX_ROTATION_DEG = 5.0           # ref dataloader.py:102
 
 
-def _sample_affine(key: jax.Array, h: int, w: int
-                   ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array,
-                              jax.Array]:
-    """Sample (theta, crop_y0, crop_x0, crop_h, crop_w) for one image."""
-    k_rot, k_scale, k_ratio, k_y, k_x = jax.random.split(key, 5)
-    theta = jax.random.uniform(
-        k_rot, minval=-MAX_ROTATION_DEG, maxval=MAX_ROTATION_DEG
-    ) * (jnp.pi / 180.0)
-    scale = jax.random.uniform(
-        k_scale, minval=SCALE_RANGE[0], maxval=SCALE_RANGE[1])
-    ratio = jnp.exp(jax.random.uniform(
-        k_ratio, minval=LOG_RATIO_RANGE[0], maxval=LOG_RATIO_RANGE[1]))
+def _sample_affine_batch(key: jax.Array, b: int, h: int, w: int
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                    jax.Array, jax.Array]:
+    """Sample (theta, crop_y0, crop_x0, crop_h, crop_w), each (b,).
+
+    ONE threefry invocation for the whole batch: a single
+    ``uniform(key, (b, 5))`` replaces per-image fold_in/split/draw chains
+    (7 batched threefry calls).  Measured v5e step time is unchanged within
+    noise — XLA overlapped the PRNG work anyway — so this is kept as a
+    simplification, not a speedup.  Draws are keyed by position in the
+    (deterministically-composed) global batch, so results remain
+    independent of device count and identical between resident and
+    streaming loaders.
+    """
+    u = jax.random.uniform(key, (b, 5))
+    theta = (2.0 * u[:, 0] - 1.0) * MAX_ROTATION_DEG * (jnp.pi / 180.0)
+    scale = SCALE_RANGE[0] + u[:, 1] * (SCALE_RANGE[1] - SCALE_RANGE[0])
+    ratio = jnp.exp(LOG_RATIO_RANGE[0]
+                    + u[:, 2] * (LOG_RATIO_RANGE[1] - LOG_RATIO_RANGE[0]))
     area = scale * h * w
     crop_w = jnp.clip(jnp.sqrt(area * ratio), 1.0, float(w))
     crop_h = jnp.clip(jnp.sqrt(area / ratio), 1.0, float(h))
-    y0 = jax.random.uniform(k_y) * (h - crop_h)
-    x0 = jax.random.uniform(k_x) * (w - crop_w)
+    y0 = u[:, 3] * (h - crop_h)
+    x0 = u[:, 4] * (w - crop_w)
     return theta, y0, x0, crop_h, crop_w
 
 
-def _warp_one(img: jax.Array, key: jax.Array, out_dim: int) -> jax.Array:
+def _warp_one(img: jax.Array, theta: jax.Array, y0: jax.Array,
+              x0: jax.Array, crop_h: jax.Array, crop_w: jax.Array,
+              out_dim: int) -> jax.Array:
     """Inverse-affine bilinear sample of one (H,W) image -> (out,out).
 
     Output pixel (i,j) -> crop-box coords in the rotated frame -> rotate by
@@ -85,7 +97,6 @@ def _warp_one(img: jax.Array, key: jax.Array, out_dim: int) -> jax.Array:
     TPU (measured: 2.7ms vs 0.25ms per 64-image step on v5e).
     """
     h, w = img.shape
-    theta, y0, x0, crop_h, crop_w = _sample_affine(key, h, w)
 
     ii = jnp.arange(out_dim, dtype=jnp.float32)
     # Half-pixel-centered resize convention (matches bilinear resize).
@@ -120,15 +131,20 @@ def train_transform(key: jax.Array, images: jax.Array, mean: jax.Array,
     b = images.shape[0]
     grayscale = images.ndim == 3
     imgs = images.astype(jnp.float32) / 255.0
-    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(b))
+    h, w = imgs.shape[1], imgs.shape[2]
+    params = _sample_affine_batch(key, b, h, w)
 
     if grayscale:
-        out = jax.vmap(_warp_one, in_axes=(0, 0, None))(imgs, keys, out_dim)
+        out = jax.vmap(_warp_one, in_axes=(0, 0, 0, 0, 0, 0, None))(
+            imgs, *params, out_dim)
         out = out[..., None].repeat(3, axis=-1)
     else:
         # Same geometric params for all channels of an image.
-        warp_hw = jax.vmap(_warp_one, in_axes=(2, None, None), out_axes=2)
-        out = jax.vmap(warp_hw, in_axes=(0, 0, None))(imgs, keys, out_dim)
+        warp_hw = jax.vmap(
+            _warp_one, in_axes=(2, None, None, None, None, None, None),
+            out_axes=2)
+        out = jax.vmap(warp_hw, in_axes=(0, 0, 0, 0, 0, 0, None))(
+            imgs, *params, out_dim)
     return ((out - mean) / std).astype(out_dtype)
 
 

@@ -210,17 +210,10 @@ class TorchDenseNet121(nn.Module):
             ("pool0", nn.MaxPool2d(3, 2, 1))]))
         ch = init_features
         for b, n_layers in enumerate(block_config):
-            block = nn.Module()
-            for i in range(n_layers):
-                block.add_module(f"denselayer{i + 1}",
-                                 _TorchDenseLayer(ch + i * growth, growth,
-                                                  bn_size))
-            # give the block a forward so the whole net runs
-            def _block_forward(self_block, x):
-                for m in self_block.children():
-                    x = m(x)
-                return x
-            block.forward = _block_forward.__get__(block)
+            block = nn.Sequential(OrderedDict([
+                (f"denselayer{i + 1}",
+                 _TorchDenseLayer(ch + i * growth, growth, bn_size))
+                for i in range(n_layers)]))
             self.features.add_module(f"denseblock{b + 1}", block)
             ch += n_layers * growth
             if b != len(block_config) - 1:

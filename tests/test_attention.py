@@ -114,3 +114,18 @@ def test_vit_with_ring_attention_matches_default(seq_mesh):
     got = ring.apply({"params": params}, x)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_ring_long_sequence(seq_mesh):
+    """Long-context shape: S=2048 over 8 devices (256 per device) — the
+    regime ring attention exists for; value-pinned to full attention."""
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    shape = (1, 2048, 2, 16)
+    q, k, v = (jax.random.normal(kk, shape, jnp.float32) for kk in ks)
+    want = attention.full_attention(q, k, v, causal=True)
+    sh = attention.sequence_sharding(seq_mesh)
+    got = attention.ring_attention(
+        jax.device_put(q, sh), jax.device_put(k, sh), jax.device_put(v, sh),
+        seq_mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-5, atol=3e-5)

@@ -46,10 +46,11 @@ def test_warp_matches_numpy_bilinear_reference():
     rng = np.random.default_rng(0)
     img = rng.uniform(0, 1, size=(28, 28)).astype(np.float32)
     key = jax.random.PRNGKey(11)
-    params = jax.device_get(augment._sample_affine(key, 28, 28))
-    theta, y0, x0, crop_h, crop_w = (float(p) for p in params)
+    params = jax.device_get(augment._sample_affine_batch(key, 1, 28, 28))
+    theta, y0, x0, crop_h, crop_w = (float(p[0]) for p in params)
 
-    ours = np.asarray(augment._warp_one(jnp.asarray(img), key, 28))
+    ours = np.asarray(augment._warp_one(
+        jnp.asarray(img), *(jnp.float32(p[0]) for p in params), 28))
     ref = _numpy_bilinear_warp(img, theta, y0, x0, crop_h, crop_w, 28)
     np.testing.assert_allclose(ours, ref, atol=1e-4)
 
@@ -63,10 +64,10 @@ def test_identity_affine_is_identity():
 
 
 def test_sampled_params_within_torchvision_ranges():
-    keys = jax.random.split(jax.random.PRNGKey(0), 64)
-    for k in keys:
-        theta, y0, x0, ch, cw = (
-            float(v) for v in jax.device_get(augment._sample_affine(k, 28, 28)))
+    theta_b, y0_b, x0_b, ch_b, cw_b = (
+        np.asarray(p) for p in jax.device_get(
+            augment._sample_affine_batch(jax.random.PRNGKey(0), 256, 28, 28)))
+    for theta, y0, x0, ch, cw in zip(theta_b, y0_b, x0_b, ch_b, cw_b):
         assert abs(theta) <= np.deg2rad(5.0) + 1e-6  # ref dataloader.py:102
         assert 1.0 <= ch <= 28.0 and 1.0 <= cw <= 28.0
         assert 0.0 <= y0 <= 28.0 - ch + 1e-5
