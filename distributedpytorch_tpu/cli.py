@@ -161,7 +161,7 @@ def _run_train_pass(engine: Engine, state, loader, epoch: int, key
 def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
                        valid_loader, model_name: str, root, start_epoch: int,
                        best_valid_loss: float, start_time: float,
-                       world: int) -> dict:
+                       world: int, shutdown) -> dict:
     """--epochs-per-dispatch > 1: K (train+valid) epochs per XLA dispatch.
 
     Per-epoch metrics and log lines are identical to the per-epoch path
@@ -249,8 +249,19 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
                                          model_name),
                     model_name, saveable, last, best_valid_loss)
         epoch = last + 1
+        # Agreed across hosts so everyone leaves at the same chunk
+        # boundary.  Granularity is the K-epoch chunk: one XLA dispatch
+        # cannot be interrupted (documented trade-off of
+        # --epochs-per-dispatch; size the grace window accordingly).
+        if runtime.any_process(shutdown.requested):
+            shutdown.requested = True
+            if runtime.is_main():
+                logging.info(f"preempted after epoch {last + 1}: "
+                             f"checkpoint written, resume with -f")
+            break
     return {"history": history, "best_valid_loss": best_valid_loss,
-            "model_name": model_name, "state": state}
+            "model_name": model_name, "state": state,
+            "preempted": shutdown.requested}
 
 
 def run_train(cfg: Config) -> dict:
@@ -339,12 +350,24 @@ def run_train(cfg: Config) -> dict:
         start_epoch, best_valid_loss = 0, float("inf")
 
     start_time = utils.monotonic()
-    if use_chunks:
-        return _run_train_chunked(cfg, engine, state, train_loader,
-                                  valid_loader, model_name, root,
-                                  start_epoch, best_valid_loss, start_time,
-                                  world)
+    shutdown = utils.GracefulShutdown()
+    with shutdown:
+        if use_chunks:
+            return _run_train_chunked(cfg, engine, state, train_loader,
+                                      valid_loader, model_name, root,
+                                      start_epoch, best_valid_loss,
+                                      start_time, world, shutdown)
+        return _run_train_epochs(cfg, engine, state, train_loader,
+                                 valid_loader, model_name, root,
+                                 start_epoch, best_valid_loss, start_time,
+                                 world, shutdown)
 
+
+def _run_train_epochs(cfg: Config, engine: Engine, state, train_loader,
+                      valid_loader, model_name: str, root, start_epoch: int,
+                      best_valid_loss: float, start_time: float, world: int,
+                      shutdown) -> dict:
+    """The per-epoch driver loop (ref classif.py:151-192)."""
     history = []
     for epoch in range(start_epoch, cfg.nb_epochs):
         if runtime.is_main():
@@ -411,10 +434,20 @@ def run_train(cfg: Config) -> dict:
         history.append({"epoch": epoch, "train_loss": train_loss,
                         "train_acc": train_acc, "valid_loss": valid_loss,
                         "valid_acc": valid_acc})
+        # Agreed across hosts (runtime.any_process) so every process
+        # leaves the loop at the SAME epoch — a lone host breaking early
+        # would deadlock the others in the next collective.
+        if runtime.any_process(shutdown.requested):
+            shutdown.requested = True
+            if runtime.is_main():
+                logging.info(f"preempted after epoch {epoch + 1}: "
+                             f"checkpoint written, resume with -f")
+            break
     # Final state is returned so callers (multi-process tests, notebooks)
     # can inspect the trained parameters without re-reading a checkpoint.
     return {"history": history, "best_valid_loss": best_valid_loss,
-            "model_name": model_name, "state": state}
+            "model_name": model_name, "state": state,
+            "preempted": shutdown.requested}
 
 
 def run_test(cfg: Config) -> dict:

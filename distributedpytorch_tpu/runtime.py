@@ -142,6 +142,22 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def any_process(flag: bool) -> bool:
+    """True when ANY process's flag is set — one tiny allgather.
+
+    Used for decisions every host must take at the SAME loop boundary
+    (e.g. preemption shutdown): without agreement, one host could break out
+    of the training loop while the rest enter the next epoch's collective
+    and deadlock waiting for it.  Single-process: no communication.
+    """
+    if jax.process_count() == 1:
+        return flag
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(np.array([flag]))
+    return bool(np.any(flags))
+
+
 def device_memory_limit() -> Optional[int]:
     """Per-device accelerator memory in bytes, or None when unknown.
 

@@ -9,7 +9,9 @@ from __future__ import annotations
 
 import logging
 import os
+import signal
 import sys
+import threading
 import time
 from typing import Tuple
 
@@ -42,6 +44,57 @@ def initialize_logging(rsl_path: str, log_file: str,
             logging.StreamHandler(sys.stdout),
         ],
     )
+
+
+class GracefulShutdown:
+    """SIGTERM/SIGINT -> finish the current epoch, checkpoint, exit clean.
+
+    SURVEY §5 failure/elastic recovery: the reference's only story is
+    manual restart with ``-f`` (ref main.py:46-48, classif.py:141-147) and
+    a bare signal kills it wherever it happens to be.  Preemptible TPU VMs
+    get SIGTERM with a grace window — under this context manager the signal
+    only sets a flag; the driver checks ``requested`` at each epoch (or,
+    under --epochs-per-dispatch K, each K-epoch chunk — one XLA dispatch is
+    not interruptible) boundary after the rolling checkpoint is written,
+    and stops cleanly, so the next run resumes with ``-f`` losing at most
+    the interrupted epoch/chunk.  Multi-host: the break decision must be
+    taken through ``runtime.any_process`` so every host leaves the loop at
+    the SAME boundary — a lone host breaking early would deadlock the rest
+    in the next collective.
+
+    A SECOND signal restores the previous handler and re-raises, so a
+    repeated Ctrl-C still force-aborts a hung or long-running dispatch.
+
+    No-op outside the main thread (Python restricts signal handlers to it);
+    ``requested`` simply stays False there.
+    """
+
+    def __init__(self):
+        self.requested = False
+        self._prev = {}
+
+    def _handle(self, signum, frame):
+        del frame
+        if self.requested:  # second signal: escalate to a real abort
+            logging.warning(f"second signal {signum}: aborting now")
+            signal.signal(signum, self._prev.get(signum, signal.SIG_DFL))
+            signal.raise_signal(signum)
+            return
+        self.requested = True
+        logging.warning(
+            f"received signal {signum}: finishing the current epoch, "
+            "then checkpointing and exiting (repeat to abort immediately)")
+
+    def __enter__(self):
+        if threading.current_thread() is threading.main_thread():
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                self._prev[sig] = signal.signal(sig, self._handle)
+        return self
+
+    def __exit__(self, *exc):
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        return False
 
 
 def get_duration(start_time: float, end_time: float) -> Tuple[int, int]:
