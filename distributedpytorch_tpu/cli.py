@@ -41,7 +41,8 @@ def _build_engine(cfg: Config, model_name: str, dataset: Dataset,
                         cfg.feature_extract)
     return Engine(model, model_name, loss_fn, tx, dataset.mean, dataset.std,
                   get_model_input_size(model_name),
-                  half_precision=cfg.half_precision)
+                  half_precision=cfg.half_precision,
+                  grad_accum=cfg.grad_accum)
 
 
 def _place_state(state, mesh, cfg: Config):
@@ -300,6 +301,10 @@ def run_train(cfg: Config) -> dict:
         raise ValueError(
             f"--epochs-per-dispatch must be >= 1, got "
             f"{cfg.epochs_per_dispatch}")
+    if cfg.grad_accum < 1 or cfg.batch_size % cfg.grad_accum:
+        raise ValueError(
+            f"--grad-accum must be >= 1 and divide the per-replica batch "
+            f"size ({cfg.batch_size}); got {cfg.grad_accum}")
     if cfg.use_pretrained:
         # Fail unsupported-arch / missing-path mistakes here, before the
         # dataset load and model init pay for a doomed run.

@@ -96,6 +96,9 @@ class Config:
     # only).  K>1 amortizes dispatch latency; checkpoints are then written
     # per chunk instead of per epoch.  1 = exact reference cadence.
     epochs_per_dispatch: int = 1
+    # Accumulate gradients over K microbatches per optimizer step (ABSENT
+    # in the reference); cuts activation memory to batch/K per step.
+    grad_accum: int = 1
     # Fold the devices into a 2-D (data, model) mesh and shard large
     # param/optimizer tensors over the 'model' axis (ZeRO/FSDP-style,
     # see parallel.py).  1 = pure data parallelism (reference semantics).
@@ -157,6 +160,10 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                    help="fuse K train+valid epochs per XLA dispatch "
                         "(resident mode; checkpoints then written per "
                         "chunk; default 1)")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   dest="gradAccum", metavar="K",
+                   help="accumulate gradients over K microbatches per "
+                        "optimizer step (default 1)")
     p.add_argument("--model-parallel", type=int, default=1,
                    dest="modelParallel", metavar="N",
                    help="shard large param/optimizer tensors over an "
@@ -211,5 +218,6 @@ def config_from_argv(argv=None) -> Config:
         synthetic_fallback=args.syntheticFallback,
         profile=args.profile,
         epochs_per_dispatch=args.epochsPerDispatch,
+        grad_accum=args.gradAccum,
         model_parallel=args.modelParallel,
     )

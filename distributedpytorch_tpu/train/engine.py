@@ -81,7 +81,8 @@ class Engine:
 
     def __init__(self, model, model_name: str, loss_fn: LossFn,
                  tx: optax.GradientTransformation, mean: float, std: float,
-                 input_size: int, half_precision: bool = True):
+                 input_size: int, half_precision: bool = True,
+                 grad_accum: int = 1):
         self.model = model
         self.model_name = model_name
         self.loss_fn = loss_fn
@@ -92,6 +93,9 @@ class Engine:
         self.compute_dtype = jnp.bfloat16 if half_precision else jnp.float32
         self.has_aux = model_name in AUX_LOGIT_MODELS
         self.uses_dropout = model_name in DROPOUT_MODELS
+        if grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+        self.grad_accum = int(grad_accum)
         self.train_step = jax.jit(self._train_step, donate_argnums=0)
         self.eval_step = jax.jit(self._eval_step)
         # Device-resident whole-epoch programs (see train_epoch/eval_epoch):
@@ -151,6 +155,10 @@ class Engine:
             out_dtype=self.compute_dtype)
         vmask = valid.astype(jnp.float32)
 
+        if self.grad_accum > 1:
+            return self._train_step_accum(state, imgs, labels, vmask,
+                                          dropout_key)
+
         def compute_loss(params):
             out, new_bs = self._apply(params, state.batch_stats, imgs,
                                       True, dropout_key)
@@ -165,18 +173,91 @@ class Engine:
 
         (loss, (logits, new_bs)), grads = jax.value_and_grad(
             compute_loss, has_aux=True)(state.params)
+        correct = jnp.sum(per_example_correct(logits, labels) * vmask)
+        return self._finish_step(state, grads, new_bs, loss, correct, vmask)
+
+    def _finish_step(self, state: TrainState, grads, new_bs, loss, correct,
+                     vmask) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        """Shared optimizer-update + metrics tail of both step variants."""
         updates, new_opt_state = self.tx.update(grads, state.opt_state,
                                                 state.params)
         new_params = optax.apply_updates(state.params, updates)
-        correct = per_example_correct(logits, labels) * vmask
         metrics = {
             "loss": loss,
-            "correct": jnp.sum(correct),
+            "correct": correct,
             "valid": jnp.sum(vmask),
         }
         return state.replace(step=state.step + 1, params=new_params,
                              batch_stats=new_bs,
                              opt_state=new_opt_state), metrics
+
+    def _train_step_accum(self, state: TrainState, imgs, labels, vmask,
+                          dropout_key
+                          ) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        """Gradient accumulation over K microbatches (ABSENT in the
+        reference — SURVEY §2 parallelism checklist; framework addition).
+
+        Exactness: the single-step loss is N(p)/D with D (the valid-mask /
+        class-weight denominator) independent of params, so
+        grad = (1/D) * grad(N).  Each microbatch contributes grad(N_k);
+        the sum is scaled by the TOTAL denominator once at the end —
+        matching the unaccumulated step to float tolerance, not just
+        approximately (proven in tests/test_grad_accum.py).  Activation
+        memory drops to one microbatch's worth.
+
+        Documented divergences under K>1: BatchNorm stats are computed per
+        microbatch (chained EMA) and dropout draws per microbatch — the
+        same semantics every major framework's accumulation has.
+        """
+        k = self.grad_accum
+        b = imgs.shape[0]
+        if b % k:
+            raise ValueError(
+                f"global batch {b} not divisible by grad_accum={k}")
+        mb = b // k
+
+        def shard(x):
+            return x.reshape((k, mb) + x.shape[1:])
+
+        imgs_m, labels_m, vmask_m = shard(imgs), shard(labels), shard(vmask)
+
+        def numer_fn(params, batch_stats, im, lb, vm, dkey):
+            out, new_bs = self._apply(params, batch_stats, im, True, dkey)
+            if self.has_aux:
+                logits, aux_logits = out
+                n_main, d = self.loss_fn(logits, lb)
+                n_aux, _ = self.loss_fn(aux_logits, lb)
+                numer = jnp.sum(n_main * vm) + 0.4 * jnp.sum(n_aux * vm)
+            else:
+                logits = out
+                n_main, d = self.loss_fn(logits, lb)
+                numer = jnp.sum(n_main * vm)
+            correct = jnp.sum(per_example_correct(logits, lb) * vm)
+            return numer, (new_bs, jnp.sum(d * vm), correct)
+
+        grad_fn = jax.value_and_grad(numer_fn, has_aux=True)
+
+        def micro(carry, xs):
+            grads_acc, numer, denom, correct, bs = carry
+            i, im, lb, vm = xs
+            # distinct dropout draw per microbatch (dropout models only)
+            (n, (new_bs, d, c)), g = grad_fn(
+                state.params, bs, im, lb, vm,
+                jax.random.fold_in(dropout_key, i))
+            grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, g)
+            return (grads_acc, numer + n, denom + d, correct + c,
+                    new_bs), None
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+        (grads_n, numer, denom, correct, new_bs), _ = jax.lax.scan(
+            micro, (zeros, 0.0, 0.0, 0.0, state.batch_stats),
+            (jnp.arange(k), imgs_m, labels_m, vmask_m))
+
+        denom_safe = jnp.maximum(denom, 1e-9)
+        grads = jax.tree_util.tree_map(lambda g: g / denom_safe, grads_n)
+        loss = numer / denom_safe
+        return self._finish_step(state, grads, new_bs, loss, correct, vmask)
 
     # -- whole-epoch device-resident programs ----------------------------
     #

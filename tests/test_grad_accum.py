@@ -1,0 +1,108 @@
+"""Gradient accumulation (--grad-accum K): a K-microbatch accumulated step
+must equal the single big-batch step — not approximately, but to float
+tolerance, because grads of the loss NUMERATOR are accumulated and scaled
+by the total denominator once (engine._train_step_accum).  ABSENT in the
+reference (SURVEY §2 parallelism checklist: no accumulation, no AMP)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.cli import run_train
+from distributedpytorch_tpu.config import Config
+from distributedpytorch_tpu.ops.losses import get_loss_fn
+from distributedpytorch_tpu.train.engine import Engine, make_optimizer
+
+
+def _engine(loss, grad_accum, model="cnn", optimizer="SGD"):
+    # SGD for the equivalence check: its update is linear in the gradient,
+    # so float-level grad equality shows through.  (Adam's first-step
+    # g/(sqrt(v)+eps) normalization amplifies fp noise on near-zero
+    # gradients into sign flips — a property of Adam, not of accumulation.)
+    tx = make_optimizer(optimizer, 1e-3, 0.9, 0.1, steps_per_epoch=4,
+                        feature_extract=False)
+    from distributedpytorch_tpu.models import get_model
+
+    weights = (np.linspace(0.5, 1.5, 10).astype(np.float32)
+               if loss == "weighted_cross_entropy" else None)
+    m = get_model(model, 10, half_precision=False)
+    return Engine(m, model, get_loss_fn(loss, weights), tx, mean=0.45,
+                  std=0.2, input_size=28, half_precision=False,
+                  grad_accum=grad_accum)
+
+
+def _batch(b=16, seed=0):
+    rng = np.random.default_rng(seed)
+    images = rng.integers(0, 256, size=(b, 28, 28), dtype=np.uint8)
+    labels = rng.integers(0, 10, size=(b,)).astype(np.int32)
+    valid = np.ones(b, dtype=bool)
+    valid[-3:] = False  # uneven masking across microbatches
+    return images, labels, valid
+
+
+@pytest.mark.parametrize("loss", ["cross_entropy", "weighted_cross_entropy",
+                                  "focal_loss"])
+def test_accumulated_step_equals_big_batch_step(loss):
+    images, labels, valid = _batch()
+    key = jax.random.PRNGKey(3)
+
+    e1 = _engine(loss, grad_accum=1)
+    e4 = _engine(loss, grad_accum=4)
+    s1 = e1.init_state(jax.random.PRNGKey(0), 1)
+    s4 = e4.init_state(jax.random.PRNGKey(0), 1)
+
+    s1, m1 = e1.train_step(s1, images, labels, valid, key)
+    s4, m4 = e4.train_step(s4, images, labels, valid, key)
+
+    np.testing.assert_allclose(float(m4["loss"]), float(m1["loss"]),
+                               rtol=1e-5)
+    assert float(m4["correct"]) == float(m1["correct"])
+    for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                    jax.tree_util.tree_leaves(s4.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_indivisible_microbatch_raises():
+    e = _engine("cross_entropy", grad_accum=5)
+    s = e.init_state(jax.random.PRNGKey(0), 1)
+    images, labels, valid = _batch(b=16)
+    with pytest.raises(ValueError, match="not divisible"):
+        e.train_step(s, images, labels, valid, jax.random.PRNGKey(1))
+
+
+def test_grad_accum_cli_e2e(tmp_path):
+    cfg = Config(action="train", data_path="/tmp/nodata",
+                 rsl_path=str(tmp_path), dataset="synthetic",
+                 model_name="mlp", batch_size=8, nb_epochs=1, debug=True,
+                 half_precision=False, grad_accum=2)
+    result = run_train(cfg)
+    assert np.isfinite(result["history"][0]["train_loss"])
+
+
+def test_grad_accum_must_divide_batch():
+    cfg = Config(action="train", data_path="/x", batch_size=8, grad_accum=3)
+    with pytest.raises(ValueError, match="grad-accum"):
+        run_train(cfg)
+
+
+def test_grad_accum_with_dropout_model():
+    """Dropout architectures accumulate too (per-microbatch dropout keys):
+    finite loss, params move."""
+    tx = make_optimizer("adam", 1e-3, 0.9, 0.1, 4, False)
+    from distributedpytorch_tpu.models import get_model
+
+    m = get_model("alexnet", 10, half_precision=False)
+    e = Engine(m, "alexnet", get_loss_fn("cross_entropy"), tx, mean=0.45,
+               std=0.2, input_size=64, half_precision=False, grad_accum=2)
+    s = e.init_state(jax.random.PRNGKey(0), 1)
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, size=(4, 64, 64), dtype=np.uint8)
+    labels = rng.integers(0, 10, size=(4,)).astype(np.int32)
+    before = jax.tree_util.tree_leaves(jax.device_get(s.params))
+    s, metrics = e.train_step(s, images, labels, np.ones(4, bool),
+                              jax.random.PRNGKey(1))
+    assert np.isfinite(float(metrics["loss"]))
+    after = jax.tree_util.tree_leaves(jax.device_get(s.params))
+    assert any(not np.allclose(a, b) for a, b in zip(before, after))
