@@ -81,8 +81,9 @@ def test_grad_accum_cli_e2e(tmp_path):
     assert np.isfinite(result["history"][0]["train_loss"])
 
 
-def test_grad_accum_must_divide_batch():
-    cfg = Config(action="train", data_path="/x", batch_size=8, grad_accum=3)
+def test_grad_accum_must_divide_batch(tmp_path):
+    cfg = Config(action="train", data_path="/x", rsl_path=str(tmp_path),
+                 batch_size=8, grad_accum=3)
     with pytest.raises(ValueError, match="grad-accum"):
         run_train(cfg)
 
