@@ -1,0 +1,47 @@
+"""Child for the multi-process preemption test: long run (100 epochs) so a
+mid-run SIGTERM to ONE host must stop BOTH via runtime.any_process
+agreement at the same epoch boundary."""
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coord", required=True)
+    ap.add_argument("--nproc", type=int, required=True)
+    ap.add_argument("--pid", type=int, required=True)
+    ap.add_argument("--rsl", required=True)
+    ap.add_argument("--out", required=True)
+    a = ap.parse_args()
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+    from distributedpytorch_tpu import runtime
+
+    runtime.initialize_distributed(coordinator_address=a.coord,
+                                   num_processes=a.nproc, process_id=a.pid)
+
+    from distributedpytorch_tpu.cli import run_train
+    from distributedpytorch_tpu.config import Config
+
+    cfg = Config(action="train", data_path="/nodata",
+                 rsl_path=os.path.join(a.rsl, f"rank{a.pid}"),
+                 dataset="synthetic", model_name="mlp", batch_size=8,
+                 nb_epochs=100, debug=True, half_precision=False)
+    result = run_train(cfg)
+    with open(a.out, "w") as f:
+        json.dump({"epochs": len(result["history"]),
+                   "preempted": bool(result.get("preempted"))}, f)
+    print(f"rank {a.pid} done after {len(result['history'])} epochs",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

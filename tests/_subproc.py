@@ -1,0 +1,29 @@
+"""Shared scaffolding for tests that drive training in subprocesses."""
+
+import os
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def child_env() -> dict:
+    """Env for a child that pins its own JAX platform: drop anything the
+    parent pytest session (conftest) injected, put the repo on the path."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def wait_for_epoch_line(log: str, procs, timeout: float = 300.0) -> None:
+    """Block until a completed-epoch line appears in ``log``; raise with
+    the child's output if any proc dies first."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(log) and "Epoch: 0" in open(log).read():
+            return
+        for p in procs:
+            if p.poll() is not None:
+                raise AssertionError(p.communicate()[0].decode()[-3000:])
+        time.sleep(1)
+    raise AssertionError(f"no epoch completed within {timeout:.0f}s")

@@ -1,0 +1,55 @@
+"""Cross-host preemption agreement: SIGTERM delivered to only ONE of two
+processes must stop BOTH at the same epoch boundary (runtime.any_process),
+with clean exits — a lone host breaking out alone would deadlock the other
+in the next collective.  This is the multi-host half of the graceful
+shutdown story (tests/test_preemption.py covers single-process)."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+from tests._subproc import REPO, child_env, wait_for_epoch_line
+
+CHILD = os.path.join(REPO, "tests", "_mp_preempt_child.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_single_host_signal_stops_all_hosts(tmp_path):
+    tmp = str(tmp_path)
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, CHILD, "--coord", f"localhost:{port}",
+         "--nproc", "2", "--pid", str(r), "--rsl", tmp,
+         "--out", os.path.join(tmp, f"out{r}.json")],
+        cwd=REPO, env=child_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT) for r in range(2)]
+    try:
+        # wait for at least one completed epoch on the main host
+        log = os.path.join(tmp, "rank0", "test.log")
+        wait_for_epoch_line(log, procs)
+
+        # preempt ONLY rank 1; rank 0 must stop too, via the agreement
+        procs[1].send_signal(signal.SIGTERM)
+        outs = [p.communicate(timeout=180)[0].decode() for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+    results = [json.load(open(os.path.join(tmp, f"out{r}.json")))
+               for r in range(2)]
+    # both stopped early, at the SAME epoch, and report preemption
+    assert results[0]["epochs"] == results[1]["epochs"], results
+    assert results[0]["epochs"] < 100, results
+    assert results[0]["preempted"] and results[1]["preempted"], results
+    assert "preempted after epoch" in open(log).read()

@@ -7,9 +7,8 @@ import os
 import signal
 import subprocess
 import sys
-import time
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from tests._subproc import REPO, child_env, wait_for_epoch_line
 
 CHILD = """
 import os
@@ -27,25 +26,14 @@ sys.exit(main(["train", "-d", "/nodata", "--rsl_path", sys.argv[1],
 
 def test_sigterm_checkpoints_and_exits_clean(tmp_path):
     rsl = str(tmp_path / "rsl")
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.Popen([sys.executable, "-c", CHILD, rsl],
-                            cwd=REPO, env=env, stdout=subprocess.PIPE,
+                            cwd=REPO, env=child_env(),
+                            stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT)
     try:
         # wait until at least one epoch has completed (log line appears)
         log = os.path.join(rsl, "test.log")
-        deadline = time.monotonic() + 300
-        while time.monotonic() < deadline:
-            if os.path.exists(log) and "Epoch: 0" in open(log).read():
-                break
-            if proc.poll() is not None:
-                raise AssertionError(
-                    proc.communicate()[0].decode()[-3000:])
-            time.sleep(1)
-        else:
-            raise AssertionError("no epoch completed within 300s")
+        wait_for_epoch_line(log, [proc])
 
         proc.send_signal(signal.SIGTERM)
         out = proc.communicate(timeout=120)[0].decode()
