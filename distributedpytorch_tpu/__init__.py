@@ -30,7 +30,13 @@ Layer map (mirrors SURVEY.md §1):
   --  models          distributedpytorch_tpu.models
   --  parallelism     distributedpytorch_tpu.parallel  (model-axis param/
                       optimizer sharding over the 2-D mesh; data
-                      parallelism itself lives in the engine + runtime)
+                      parallelism itself lives in the engine + runtime;
+                      sequence parallelism = ops.attention ring attention)
+
+Framework additions beyond the reference's capability set (each tested):
+ViT model family + sequence-parallel ring attention, gradient
+accumulation, model-parallel (ZeRO-style) param sharding, preemption-safe
+graceful shutdown with cross-host agreement, analytic FLOP/MFU accounting.
 """
 
 __version__ = "0.1.0"

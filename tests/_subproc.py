@@ -1,9 +1,58 @@
 """Shared scaffolding for tests that drive training in subprocesses."""
 
 import os
+import socket
+import subprocess
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def await_all(procs, log_paths, timeout: float = 1800.0) -> None:
+    """Wait for every child against ONE shared deadline; on nonzero exit
+    or timeout, raise with the tail of the child's log; always kill
+    stragglers."""
+    deadline = time.monotonic() + timeout
+    try:
+        for r, p in enumerate(procs):
+            try:
+                rc = p.wait(timeout=max(1.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                raise AssertionError(
+                    f"child {r} still running at deadline\n"
+                    f"{_tail(log_paths[r])}") from None
+            if rc != 0:
+                raise AssertionError(
+                    f"child {r} exited rc={rc}\n{_tail(log_paths[r])}")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def _tail(path: str, n: int = 4000) -> str:
+    try:
+        return open(path).read()[-n:]
+    except OSError:
+        return "<no log>"
+
+
+def launch_logged(cmd, log_path: str) -> subprocess.Popen:
+    """Start a child with stdout/stderr appended to ``log_path``.
+
+    ALWAYS a file, never subprocess.PIPE: an undrained pipe backpressures
+    a chatty child into blocking on print — for distributed children that
+    stalls their collectives and deadlocks every process in the world.
+    """
+    out = open(log_path, "ab")
+    return subprocess.Popen(cmd, cwd=REPO, env=child_env(),
+                            stdout=out, stderr=out)
 
 
 def child_env() -> dict:
@@ -15,15 +64,19 @@ def child_env() -> dict:
     return env
 
 
-def wait_for_epoch_line(log: str, procs, timeout: float = 300.0) -> None:
+def wait_for_epoch_line(log: str, procs, timeout: float = 300.0,
+                        proc_logs=()) -> None:
     """Block until a completed-epoch line appears in ``log``; raise with
     the child's output if any proc dies first."""
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if os.path.exists(log) and "Epoch: 0" in open(log).read():
             return
-        for p in procs:
+        for i, p in enumerate(procs):
             if p.poll() is not None:
-                raise AssertionError(p.communicate()[0].decode()[-3000:])
+                detail = (open(proc_logs[i]).read()[-3000:]
+                          if i < len(proc_logs) else "")
+                raise AssertionError(
+                    f"child {i} exited rc={p.returncode}\n{detail}")
         time.sleep(1)
     raise AssertionError(f"no epoch completed within {timeout:.0f}s")

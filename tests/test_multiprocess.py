@@ -18,45 +18,30 @@ pipeline.py and the global ``is_main`` gating.  Asserts:
 """
 
 import os
-import socket
-import subprocess
 import sys
 
 import numpy as np
 import pytest
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from tests._subproc import REPO, await_all, free_port, launch_logged
+
 CHILD = os.path.join(REPO, "tests", "_mp_child.py")
 NPROC = 2
 DEVICES_PER_PROC = 2
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+def _log_path(tmp: str, nproc: int, rank: int) -> str:
+    return os.path.join(tmp, f"log_n{nproc}_r{rank}.txt")
 
 
-def _child_env() -> dict:
-    env = os.environ.copy()
-    # The child pins its own XLA_FLAGS/platform; drop anything the parent
-    # test session (conftest) injected so it cannot leak in first.
-    env.pop("XLA_FLAGS", None)
-    env.pop("JAX_PLATFORMS", None)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    return env
-
-
-def _launch(rank: int, nproc: int, devices: int, port: int, tmp: str
-            ) -> subprocess.Popen:
-    return subprocess.Popen(
+def _launch(rank: int, nproc: int, devices: int, port: int, tmp: str):
+    return launch_logged(
         [sys.executable, CHILD, "--coord", f"localhost:{port}",
          "--nproc", str(nproc), "--pid", str(rank),
          "--devices-per-proc", str(devices),
          "--rsl", os.path.join(tmp, f"n{nproc}"),
          "--out", os.path.join(tmp, f"out_n{nproc}_r{rank}.npz")],
-        env=_child_env(), cwd=REPO,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        _log_path(tmp, nproc, rank))
 
 
 @pytest.fixture(scope="module")
@@ -64,17 +49,14 @@ def mp_runs(tmp_path_factory):
     tmp = str(tmp_path_factory.mktemp("mp"))
 
     # Multi-process world: 2 hosts x 2 devices, one shared coordinator.
-    port = _free_port()
+    port = free_port()
     procs = [_launch(r, NPROC, DEVICES_PER_PROC, port, tmp)
              for r in range(NPROC)]
-    logs = [p.communicate(timeout=900)[0].decode() for p in procs]
-    for r, (p, log) in enumerate(zip(procs, logs)):
-        assert p.returncode == 0, f"rank {r} failed:\n{log[-4000:]}"
+    await_all(procs, [_log_path(tmp, NPROC, r) for r in range(NPROC)])
 
     # Single-process control: 1 host x 4 devices — same world size.
-    ctrl = _launch(0, 1, NPROC * DEVICES_PER_PROC, _free_port(), tmp)
-    log = ctrl.communicate(timeout=900)[0].decode()
-    assert ctrl.returncode == 0, f"control failed:\n{log[-4000:]}"
+    ctrl = _launch(0, 1, NPROC * DEVICES_PER_PROC, free_port(), tmp)
+    await_all([ctrl], [_log_path(tmp, 1, 0)])
 
     return tmp
 

@@ -7,45 +7,40 @@ shutdown story (tests/test_preemption.py covers single-process)."""
 import json
 import os
 import signal
-import socket
-import subprocess
 import sys
 
-from tests._subproc import REPO, child_env, wait_for_epoch_line
+from tests._subproc import (REPO, free_port, launch_logged,
+                            wait_for_epoch_line)
 
 CHILD = os.path.join(REPO, "tests", "_mp_preempt_child.py")
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
-
-
 def test_single_host_signal_stops_all_hosts(tmp_path):
     tmp = str(tmp_path)
-    port = _free_port()
-    procs = [subprocess.Popen(
+    port = free_port()
+    child_logs = [os.path.join(tmp, f"child{r}.txt") for r in range(2)]
+    procs = [launch_logged(
         [sys.executable, CHILD, "--coord", f"localhost:{port}",
          "--nproc", "2", "--pid", str(r), "--rsl", tmp,
          "--out", os.path.join(tmp, f"out{r}.json")],
-        cwd=REPO, env=child_env(), stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT) for r in range(2)]
+        child_logs[r]) for r in range(2)]
     try:
         # wait for at least one completed epoch on the main host
         log = os.path.join(tmp, "rank0", "test.log")
-        wait_for_epoch_line(log, procs)
+        wait_for_epoch_line(log, procs, proc_logs=child_logs)
 
         # preempt ONLY rank 1; rank 0 must stop too, via the agreement
         procs[1].send_signal(signal.SIGTERM)
-        outs = [p.communicate(timeout=180)[0].decode() for p in procs]
+        for p in procs:
+            p.wait(timeout=300)
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
 
-    for r, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+    for r, p in enumerate(procs):
+        assert p.returncode == 0, \
+            f"rank {r}:\n{open(child_logs[r]).read()[-3000:]}"
     results = [json.load(open(os.path.join(tmp, f"out{r}.json")))
                for r in range(2)]
     # both stopped early, at the SAME epoch, and report preemption

@@ -16,9 +16,9 @@ from distributedpytorch_tpu.ops.losses import get_loss_fn
 from distributedpytorch_tpu.train.engine import Engine, make_optimizer
 
 
-def _engine():
+def _engine(optimizer="adam"):
     model = get_model("mlp", 10, half_precision=False)
-    tx = make_optimizer("adam", 1e-3, 0.9, 0.1, steps_per_epoch=4,
+    tx = make_optimizer(optimizer, 1e-3, 0.9, 0.1, steps_per_epoch=4,
                         feature_extract=False)
     return Engine(model, "mlp", get_loss_fn("cross_entropy"), tx,
                   mean=0.45, std=0.2, input_size=28, half_precision=False)
@@ -42,7 +42,13 @@ def test_leaf_spec_rules():
 
 
 def test_sharded_step_equals_replicated():
-    engine = _engine()
+    # SGD for the param-equality check: its update is linear in the
+    # gradient, so float-level grad equality shows through.  Adam's
+    # first-step g/(sqrt(v)+eps) normalization turns fp-reassociation
+    # noise on near-zero gradients (the two layouts decompose the
+    # collectives differently) into +-lr sign flips — a property of Adam,
+    # not of the sharding (same situation as tests/test_grad_accum.py).
+    engine = _engine("SGD")
     images, labels, valid = _batch()
     key = jax.random.PRNGKey(1)
 

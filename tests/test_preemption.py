@@ -5,10 +5,9 @@ has none; a bare signal kills it wherever it is)."""
 
 import os
 import signal
-import subprocess
 import sys
 
-from tests._subproc import REPO, child_env, wait_for_epoch_line
+from tests._subproc import launch_logged, wait_for_epoch_line
 
 CHILD = """
 import os
@@ -26,20 +25,19 @@ sys.exit(main(["train", "-d", "/nodata", "--rsl_path", sys.argv[1],
 
 def test_sigterm_checkpoints_and_exits_clean(tmp_path):
     rsl = str(tmp_path / "rsl")
-    proc = subprocess.Popen([sys.executable, "-c", CHILD, rsl],
-                            cwd=REPO, env=child_env(),
-                            stdout=subprocess.PIPE,
-                            stderr=subprocess.STDOUT)
+    child_log = str(tmp_path / "child.txt")
+    proc = launch_logged([sys.executable, "-c", CHILD, rsl], child_log)
     try:
         # wait until at least one epoch has completed (log line appears)
         log = os.path.join(rsl, "test.log")
-        wait_for_epoch_line(log, [proc])
+        wait_for_epoch_line(log, [proc], proc_logs=[child_log])
 
         proc.send_signal(signal.SIGTERM)
-        out = proc.communicate(timeout=120)[0].decode()
+        proc.wait(timeout=120)
     finally:
         if proc.poll() is None:
             proc.kill()
+    out = open(child_log).read()
     assert proc.returncode == 0, out[-3000:]
     text = open(log).read()
     assert "preempted after epoch" in text, text[-2000:]
