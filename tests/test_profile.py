@@ -1,0 +1,40 @@
+"""SURVEY §5 tracing: --profile writes a jax.profiler trace of the first
+post-compile epoch; print_network_info logs the param inventory (the
+reference defines printNetworkInfo but it is unused AND crashes —
+ref utils.py:164-166)."""
+
+import logging
+import os
+
+import jax
+import numpy as np
+
+from distributedpytorch_tpu import utils
+from distributedpytorch_tpu.cli import run_train
+from distributedpytorch_tpu.config import Config
+from distributedpytorch_tpu.models import get_model
+
+
+def test_profile_flag_writes_trace(tmp_path):
+    cfg = Config(action="train", data_path="/tmp/nodata",
+                 rsl_path=str(tmp_path), dataset="synthetic",
+                 model_name="mlp", batch_size=8, nb_epochs=2, debug=True,
+                 half_precision=False, profile=True)
+    result = run_train(cfg)
+    assert len(result["history"]) == 2
+    trace_dir = tmp_path / "trace"
+    assert trace_dir.is_dir()
+    # at least one trace artifact landed under the directory
+    found = [f for _, _, fs in os.walk(trace_dir) for f in fs]
+    assert found, "profiler trace directory is empty"
+    assert "profiler trace written" in (tmp_path / cfg.log_file).read_text()
+
+
+def test_print_network_info_logs_inventory(caplog):
+    model = get_model("mlp", 10, half_precision=False)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        np.zeros((1, 28, 28, 3), np.float32),
+                        train=False)["params"]
+    with caplog.at_level(logging.INFO):
+        utils.print_network_info(params)
+    assert any("total parameters" in r.message for r in caplog.records)
