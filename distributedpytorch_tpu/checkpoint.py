@@ -18,12 +18,26 @@ Divergences (improvements, documented): writes are atomic (tmp+rename);
 checkpoints are written from *unwrapped, replicated* state, so a checkpoint
 trained on N chips loads anywhere (the reference saves DDP ``module.``-
 prefixed keys that only load back into a DDP wrapper — SURVEY defect #11).
+
+Two formats behind one API (``--ckpt-format``):
+  * ``msgpack`` (default): single self-describing file, the
+    reference-contract format above; sharded state is all-gathered
+    (collectively) before the main process writes.
+  * ``orbax``: a checkpoint DIRECTORY written by orbax's
+    StandardCheckpointer — sharded params/optimizer state are saved
+    AS-LAID-OUT, no gather, which is the TPU-native shape of
+    checkpointing once --model-parallel states outgrow one host.  The
+    five logical fields are preserved (meta.json + the state tree);
+    ``test -f DIR`` and resume work identically.  Validated single-host;
+    multi-host orbax coordination is not exercised in this environment.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
+import shutil
 from typing import Optional, Tuple
 
 import jax
@@ -34,6 +48,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from .train.engine import TrainState
 
 _FORMAT_VERSION = 1
+_ORBAX_META = "meta.json"
 
 
 def gather_replicated(state: TrainState) -> TrainState:
@@ -84,11 +99,16 @@ def best_model_path(rsl_path: str, dataset: str, model_name: str) -> str:
 
 
 def save_checkpoint(path: str, model_name: str, state: TrainState,
-                    epoch: int, best_valid_loss: float) -> None:
-    """ref saveCheckpoint (utils.py:112-121); caller gates on is_main() —
-    but on multi-host meshes the caller must run ``gather_replicated`` on
-    every process FIRST and pass the gathered state (the internal call
-    below is then a no-op; it only covers single-host callers)."""
+                    epoch: int, best_valid_loss: float,
+                    fmt: str = "msgpack") -> None:
+    """ref saveCheckpoint (utils.py:112-121); for msgpack the caller gates
+    on is_main() — but on multi-host meshes the caller must run
+    ``gather_replicated`` on every process FIRST and pass the gathered
+    state (the internal call below is then a no-op; it only covers
+    single-host callers).  For orbax, EVERY process calls this (each host
+    writes its own shards) and no gather happens at all."""
+    if fmt == "orbax":
+        return _save_orbax(path, model_name, state, epoch, best_valid_loss)
     payload = {
         "format_version": _FORMAT_VERSION,
         "model_name": model_name,
@@ -104,6 +124,86 @@ def save_checkpoint(path: str, model_name: str, state: TrainState,
         f.write(blob)
     os.replace(tmp, path)
     logging.info(f"epoch:{epoch:04d}: model saved to {path}")
+
+
+def require_orbax() -> None:
+    """Raise the CLI-catchable ValueError when orbax is unavailable —
+    checked up front (run_train/run_test) so --ckpt-format orbax cannot
+    traceback after a full epoch of training."""
+    try:
+        import orbax.checkpoint  # noqa: F401
+    except ImportError as e:
+        raise ValueError(
+            "--ckpt-format orbax requires the 'orbax-checkpoint' package "
+            "(pip install orbax-checkpoint)") from e
+
+
+def _save_orbax(path: str, model_name: str, state: TrainState,
+                epoch: int, best_valid_loss: float) -> None:
+    import orbax.checkpoint as ocp
+
+    from . import runtime
+
+    path = os.path.abspath(path)
+    tmp = path + ".tmp"
+    # Atomic-ish overwrite, mirroring the msgpack tmp+rename: the COMPLETE
+    # checkpoint (state + meta) is assembled under .tmp, then swapped in.
+    # A crash mid-save leaves the previous bestmodel intact.
+    if jax.process_index() == 0 and os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    runtime.barrier()  # nobody saves into .tmp until the cleanup is done
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(tmp, "state"),
+               serialization.to_state_dict(state))
+    ckptr.wait_until_finished()
+    runtime.barrier()  # every host's shards are on disk before the swap
+    if jax.process_index() == 0:
+        with open(os.path.join(tmp, _ORBAX_META), "w") as f:
+            json.dump({"format_version": _FORMAT_VERSION,
+                       "model_name": model_name, "epoch": int(epoch),
+                       "loss": float(best_valid_loss)}, f)
+        if os.path.exists(path):
+            shutil.rmtree(path)
+        os.replace(tmp, path)
+        logging.info(f"epoch:{epoch:04d}: model saved to {path}")
+    runtime.barrier()  # no host proceeds until the swap is visible
+
+
+def _load_orbax(path: str, state: TrainState, restore_optimizer: bool
+                ) -> Tuple[TrainState, int, float]:
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    meta_path = os.path.join(path, _ORBAX_META)
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as e:
+        raise ValueError(f"{path}: not a valid orbax checkpoint "
+                         f"({e})") from e
+    if meta.get("format_version") != _FORMAT_VERSION:
+        raise ValueError(f"{path}: unsupported checkpoint format "
+                         f"{meta.get('format_version')!r}")
+    # Shapes/dtypes only — no device_get: the template may hold sharded
+    # (multi-host: non-addressable) arrays, and copying params+opt_state
+    # to host just to read .shape would be waste anyway.
+    template = serialization.to_state_dict(state)
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(
+            tuple(np.shape(x)), getattr(x, "dtype", np.asarray(x).dtype)),
+        template)
+    try:
+        restored_dict = ocp.StandardCheckpointer().restore(
+            os.path.join(path, "state"), abstract)
+    except Exception as e:
+        raise ValueError(f"cannot restore orbax checkpoint {path!r}: "
+                         f"{e}") from e
+    if not restore_optimizer:
+        restored_dict["opt_state"] = template.get("opt_state", {})
+    restored = serialization.from_state_dict(state, restored_dict)
+    epoch = int(meta["epoch"]) + 1
+    logging.info(f"epoch:{epoch:04d}: model loaded from {path}")
+    return restored, epoch, float(meta["loss"])
 
 
 def _read(path: str) -> dict:
@@ -133,7 +233,10 @@ def load_checkpoint(path: str, state: TrainState,
                     ) -> Tuple[TrainState, int, float]:
     """ref loadCheckpoint (utils.py:123-136): returns (state, next_epoch,
     best_valid_loss).  ``state`` is a template with the right structure
-    (fresh Engine.init_state output); restored arrays replace its leaves."""
+    (fresh Engine.init_state output); restored arrays replace its leaves.
+    Format is auto-detected: an orbax checkpoint is a directory."""
+    if os.path.isdir(path):
+        return _load_orbax(path, state, restore_optimizer)
     payload = _read(path)
     template = jax.device_get(gather_replicated(state))
     if not restore_optimizer:  # test path passes optimizer=None (ref :232)
@@ -147,13 +250,23 @@ def load_checkpoint(path: str, state: TrainState,
 
 
 def get_checkpoint_model_name(path: str) -> str:
-    """ref getCheckpointModelName (utils.py:138-140)."""
+    """ref getCheckpointModelName (utils.py:138-140); both formats."""
+    if os.path.isdir(path):
+        meta_path = os.path.join(path, _ORBAX_META)
+        try:
+            with open(meta_path) as f:
+                return str(json.load(f)["model_name"])
+        except (OSError, ValueError, KeyError) as e:
+            raise ValueError(f"{path}: not a valid orbax checkpoint "
+                             f"({e})") from e
     return str(_read(path)["model_name"])
 
 
 def rotate_checkpoint(rsl_path: str, dataset: str, model_name: str,
                       epoch: int) -> None:
-    """Delete epoch-1's rolling file (ref classif.py:182-184, fixed)."""
+    """Delete epoch-1's rolling file/dir (ref classif.py:182-184, fixed)."""
     prev = checkpoint_path(rsl_path, dataset, model_name, epoch - 1)
-    if os.path.exists(prev):
+    if os.path.isdir(prev):
+        shutil.rmtree(prev)
+    elif os.path.exists(prev):
         os.remove(prev)

@@ -75,6 +75,39 @@ def _resident_budget_bytes(cfg: Config) -> int:
     return budget
 
 
+def _validate_ckpt_format(cfg: Config) -> None:
+    """Fail typos and a missing orbax up front (CLI argparse already
+    restricts choices; this covers programmatic Config construction and
+    surfaces the orbax dependency before any training happens)."""
+    if cfg.ckpt_format not in ("msgpack", "orbax"):
+        raise ValueError(
+            f"ckpt_format must be 'msgpack' or 'orbax', "
+            f"got {cfg.ckpt_format!r}")
+    if cfg.ckpt_format == "orbax":
+        ckpt.require_orbax()
+
+
+def _saveable_state(cfg: Config, state):
+    """What the checkpoint writer receives: msgpack needs the collective
+    all-gather (every process participates); orbax saves sharded state
+    as-laid-out, so no gather at all."""
+    if cfg.ckpt_format == "orbax":
+        return state
+    return ckpt.gather_replicated(state)
+
+
+def _save_ckpt(cfg: Config, path: str, model_name: str, saveable,
+               epoch: int, best_valid_loss: float) -> None:
+    """msgpack: main-only file write; orbax: EVERY process calls (each
+    host writes its own shards)."""
+    if cfg.ckpt_format == "orbax":
+        ckpt.save_checkpoint(path, model_name, saveable, epoch,
+                             best_valid_loss, fmt="orbax")
+    elif runtime.is_main():
+        ckpt.save_checkpoint(path, model_name, saveable, epoch,
+                             best_valid_loss)
+
+
 def _make_loader(cfg: Config, split: Split, mesh, shuffle: bool):
     """Pick resident (whole split in HBM, one dispatch per epoch) vs
     streamed batching.  'auto' keeps small corpora on device, bounded by
@@ -226,29 +259,27 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
                             "valid_acc": valid_acc})
 
         last = chunk[-1]
-        # Collective on multi-host model-parallel meshes: every process
-        # joins the all-gather; only main writes the files below.
-        saveable = ckpt.gather_replicated(state)
+        saveable = _saveable_state(cfg, state)
         if runtime.is_main():
             ckpt.rotate_checkpoint(cfg.rsl_path, cfg.dataset, model_name,
                                    last)
             for prev in chunk[:-1]:  # rolling files from earlier chunks
                 ckpt.rotate_checkpoint(cfg.rsl_path, cfg.dataset, model_name,
                                        prev)
-            ckpt.save_checkpoint(
-                ckpt.checkpoint_path(cfg.rsl_path, cfg.dataset, model_name,
-                                     last),
-                model_name, saveable, last, best_valid_loss)
-            if chunk_improved:
-                # Only the chunk-final state exists on host, so the best
-                # file holds it (an approximation of the true best epoch
-                # inside the chunk) — but it is written whenever ANY epoch
-                # in the chunk improved, keeping the recorded
-                # best_valid_loss and the best-model file in sync.
-                ckpt.save_checkpoint(
-                    ckpt.best_model_path(cfg.rsl_path, cfg.dataset,
-                                         model_name),
-                    model_name, saveable, last, best_valid_loss)
+        _save_ckpt(cfg,
+                   ckpt.checkpoint_path(cfg.rsl_path, cfg.dataset,
+                                        model_name, last),
+                   model_name, saveable, last, best_valid_loss)
+        if chunk_improved:
+            # Only the chunk-final state exists on host, so the best
+            # file holds it (an approximation of the true best epoch
+            # inside the chunk) — but it is written whenever ANY epoch
+            # in the chunk improved, keeping the recorded
+            # best_valid_loss and the best-model file in sync.
+            _save_ckpt(cfg,
+                       ckpt.best_model_path(cfg.rsl_path, cfg.dataset,
+                                            model_name),
+                       model_name, saveable, last, best_valid_loss)
         epoch = last + 1
         # Agreed across hosts so everyone leaves at the same chunk
         # boundary.  Granularity is the K-epoch chunk: one XLA dispatch
@@ -305,6 +336,7 @@ def run_train(cfg: Config) -> dict:
         raise ValueError(
             f"--grad-accum must be >= 1 and divide the per-replica batch "
             f"size ({cfg.batch_size}); got {cfg.grad_accum}")
+    _validate_ckpt_format(cfg)
     if cfg.use_pretrained:
         # Fail unsupported-arch / missing-path mistakes here, before the
         # dataset load and model init pay for a doomed run.
@@ -410,9 +442,7 @@ def _run_train_epochs(cfg: Config, engine: Engine, state, train_loader,
         improved = valid_loss < best_valid_loss
         if improved:
             best_valid_loss = valid_loss
-        # Collective on multi-host model-parallel meshes: every process
-        # joins the all-gather; only main writes the files below.
-        saveable = ckpt.gather_replicated(state)
+        saveable = _saveable_state(cfg, state)
         if runtime.is_main():  # ref classif.py:176-192
             logging.info(
                 f"{'*' if improved else ' '} Epoch: {epoch + 1:03}  "
@@ -427,15 +457,15 @@ def _run_train_epochs(cfg: Config, engine: Engine, state, train_loader,
                          f"({world} chip{'s' if world > 1 else ''})")
             ckpt.rotate_checkpoint(cfg.rsl_path, cfg.dataset, model_name,
                                    epoch)
-            ckpt.save_checkpoint(
-                ckpt.checkpoint_path(cfg.rsl_path, cfg.dataset, model_name,
-                                     epoch),
-                model_name, saveable, epoch, best_valid_loss)
-            if improved:
-                ckpt.save_checkpoint(
-                    ckpt.best_model_path(cfg.rsl_path, cfg.dataset,
-                                         model_name),
-                    model_name, saveable, epoch, best_valid_loss)
+        _save_ckpt(cfg,
+                   ckpt.checkpoint_path(cfg.rsl_path, cfg.dataset,
+                                        model_name, epoch),
+                   model_name, saveable, epoch, best_valid_loss)
+        if improved:
+            _save_ckpt(cfg,
+                       ckpt.best_model_path(cfg.rsl_path, cfg.dataset,
+                                            model_name),
+                       model_name, saveable, epoch, best_valid_loss)
         history.append({"epoch": epoch, "train_loss": train_loss,
                         "train_acc": train_acc, "valid_loss": valid_loss,
                         "valid_acc": valid_acc})
@@ -463,6 +493,7 @@ def run_test(cfg: Config) -> dict:
         raise ValueError(
             "--use-pretrained is not applicable to the test subcommand: "
             "weights come from -f FILE")
+    _validate_ckpt_format(cfg)
     runtime.initialize_distributed()
     utils.initialize_logging(cfg.rsl_path, cfg.log_file,
                              truncate=runtime.is_main())

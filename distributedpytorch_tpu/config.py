@@ -99,6 +99,10 @@ class Config:
     # Accumulate gradients over K microbatches per optimizer step (ABSENT
     # in the reference); cuts activation memory to batch/K per step.
     grad_accum: int = 1
+    # 'msgpack': single-file reference-contract checkpoints (default);
+    # 'orbax': directory checkpoints, sharded state saved as-laid-out
+    # (no gather) — see checkpoint.py.
+    ckpt_format: str = "msgpack"
     # Fold the devices into a 2-D (data, model) mesh and shard large
     # param/optimizer tensors over the 'model' axis (ZeRO/FSDP-style,
     # see parallel.py).  1 = pure data parallelism (reference semantics).
@@ -160,6 +164,11 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                    help="fuse K train+valid epochs per XLA dispatch "
                         "(resident mode; checkpoints then written per "
                         "chunk; default 1)")
+    p.add_argument("--ckpt-format", choices=("msgpack", "orbax"),
+                   default="msgpack", dest="ckptFormat",
+                   help="checkpoint format: single msgpack file (default) "
+                        "or an orbax directory with sharded-as-laid-out "
+                        "state")
     p.add_argument("--grad-accum", type=int, default=1,
                    dest="gradAccum", metavar="K",
                    help="accumulate gradients over K microbatches per "
@@ -219,5 +228,6 @@ def config_from_argv(argv=None) -> Config:
         profile=args.profile,
         epochs_per_dispatch=args.epochsPerDispatch,
         grad_accum=args.gradAccum,
+        ckpt_format=args.ckptFormat,
         model_parallel=args.modelParallel,
     )

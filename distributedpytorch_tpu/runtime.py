@@ -142,6 +142,18 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def barrier() -> None:
+    """Block until every process reaches this point (no-op single host).
+
+    Used around multi-writer filesystem operations (orbax checkpoint
+    swap): a delete racing another host's writes corrupts the checkpoint.
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("dpt_barrier")
+
+
 def any_process(flag: bool) -> bool:
     """True when ANY process's flag is set — one tiny allgather.
 
