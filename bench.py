@@ -11,8 +11,8 @@ are excluded (BASELINE.md measurement rules), seed 1234, batch 64/replica
 Default (what the driver runs): ONE JSON line to stdout with the headline
 CNN number; diagnostics on stderr.  Extra modes:
 
-  --suite      also measure large-batch CNN, MLP, and ResNet-18 on a
-               CIFAR-shaped corpus; writes BENCH_SUITE.json
+  --suite      also measure large-batch CNN, MLP, ViT, and ResNet-18
+               (on a CIFAR-shaped corpus); writes BENCH_SUITE.json
   --scaling    weak-scaling mechanism measurement on a virtual CPU mesh
                (1 vs 8 devices, batch 64/replica) — the only scaling
                number available with one physical chip
@@ -288,6 +288,8 @@ def run_suite(args) -> dict:
     rows["cnn_b64"] = bench_ours(64, args.steps, "cnn")
     rows["cnn_b512"] = bench_ours(512, args.steps, "cnn")
     rows["mlp_b64"] = bench_ours(64, args.steps, "mlp")
+    # the attention model family (framework addition; models/vit.py)
+    rows["vit_b64"] = bench_ours(64, args.steps, "vit")
     # ResNet-18, CIFAR-shaped 32x32x3 corpus, warped to the registry's
     # 224 input on device (the reference resizes everything to 224 too,
     # ref utils.py:24-36).  One epoch per dispatch: at ~1e9 FLOPs/sample
