@@ -13,10 +13,13 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
+from ..ops.pooling import max_pool_2x2
+
 
 class SmallCNN(nn.Module):
     """Conv-conv-pool x2 + dense.  Channel widths are multiples of 32/64 so
-    XLA tiles the im2col matmuls cleanly onto the 128x128 MXU."""
+    XLA tiles the im2col matmuls cleanly onto the 128x128 MXU; pooling uses
+    the select-and-scatter-free max_pool_2x2 (ops/pooling.py)."""
 
     num_classes: int = 10
     dtype: Any = jnp.bfloat16
@@ -29,7 +32,7 @@ class SmallCNN(nn.Module):
             x = nn.relu(x)
             x = nn.Conv(width, (3, 3), padding="SAME", dtype=self.dtype)(x)
             x = nn.relu(x)
-            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            x = max_pool_2x2(x)
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(256, dtype=self.dtype)(x))
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)

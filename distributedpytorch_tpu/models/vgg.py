@@ -14,6 +14,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from .common import adaptive_avg_pool
+from ..ops.pooling import max_pool_2x2
 
 _VGG11 = (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M")
 
@@ -27,7 +28,8 @@ class VGG11BN(nn.Module):
         x = x.astype(self.dtype)
         for v in _VGG11:
             if v == "M":
-                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+                # select-and-scatter-free backward (ops/pooling.py)
+                x = max_pool_2x2(x)
             else:
                 # bias kept despite the following BN: torchvision's
                 # make_layers leaves Conv2d bias on in vgg11_bn, and exact

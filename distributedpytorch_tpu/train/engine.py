@@ -36,6 +36,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import flax.struct
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from ..data import augment
@@ -113,6 +114,13 @@ class Engine:
             functools.partial(self.model.init, train=True)
         )({"params": key, "dropout": jax.random.fold_in(key, 1)}, x)
         params = variables["params"]
+        try:  # abstract trace, no device work — gates _pregather
+            from ..ops import flops as flops_mod
+            self._flops_per_sample = flops_mod.train_flops_per_sample(
+                self.model, params, variables.get("batch_stats", {}),
+                batch=8, input_size=self.input_size)
+        except Exception:
+            self._flops_per_sample = None
         return TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -150,6 +158,16 @@ class Engine:
                     ) -> Tuple[TrainState, Dict[str, jax.Array]]:
         step_key = jax.random.fold_in(key, state.step)
         aug_key, dropout_key = jax.random.split(step_key)
+        return self._train_step_keys(state, images_u8, labels, valid,
+                                     aug_key, dropout_key)
+
+    def _train_step_keys(self, state: TrainState, images_u8, labels, valid,
+                         aug_key, dropout_key
+                         ) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        """Step body with the per-step keys already derived.  The epoch
+        scans hoist key derivation (fold_in + split are ~40 serialized
+        scalar-unit hash rounds — measurable per step on TPU) into ONE
+        batched threefry before the loop; values are identical."""
         imgs = augment.train_transform(
             aug_key, images_u8, self.mean, self.std, self.input_size,
             out_dtype=self.compute_dtype)
@@ -217,7 +235,17 @@ class Engine:
         mb = b // k
 
         def shard(x):
-            return x.reshape((k, mb) + x.shape[1:])
+            # Stride-k microbatches (rows j, j+k, j+2k, ...), NOT
+            # contiguous blocks: with the global batch sharded over
+            # 'data' in per-device blocks whose size the per-replica
+            # batch (and hence, given batch % k == 0, a multiple of k),
+            # a stride-k slice takes exactly rows-per-device/k rows from
+            # EVERY device's block — each scan iteration stays device-
+            # local, no resharding collective.  A contiguous split would
+            # make microbatch j span a fraction of every device only when
+            # k <= world; for k > 1 generally it concentrates rows on few
+            # devices and GSPMD inserts a reshard per iteration.
+            return jnp.moveaxis(x.reshape((mb, k) + x.shape[1:]), 1, 0)
 
         imgs_m, labels_m, vmask_m = shard(imgs), shard(labels), shard(vmask)
 
@@ -270,29 +298,97 @@ class Engine:
     # so streaming and resident modes train identically
     # (tests/test_resident.py proves it).
 
+    # Per-step in-scan gathers of 64 u8 rows cost 18.5 us/step on a v5e
+    # (measured, scripts/trace_ops.py: row-granular HBM gathers don't
+    # stream).  ONE bulk take of the whole epoch plan before the scan
+    # removes two gather ops from the loop body: -30 us/step on the
+    # cnn/b64 headline and a 1.27x win on the mlp, whose 80-us steps are
+    # gather-bound.  It LOSES ~5% on compute-heavy steps (vit: 1.55 ms
+    # steps hide the in-scan gather behind compute, while the bulk copy
+    # is serialized ahead of the scan), so it is gated on the model's
+    # analytic FLOPs/sample (computed abstractly in init_state) and on a
+    # bytes cap for the epoch-plan copy.  Values are identical either
+    # way — only the schedule moves.
+    PREGATHER_MAX_BYTES = 1 << 30
+    PREGATHER_MAX_FLOPS_PER_SAMPLE = 2e8
+
+    _flops_per_sample: Optional[float] = None
+
+    def _pregather(self, images_all, labels_all, idx):
+        """(S, B) plan -> ((S, B, ...) images, (S, B) labels) or None."""
+        if (self._flops_per_sample is None
+                or self._flops_per_sample
+                > self.PREGATHER_MAX_FLOPS_PER_SAMPLE):
+            return None
+        sample_bytes = (int(np.prod(images_all.shape[1:]))
+                        * images_all.dtype.itemsize)
+        if idx.size * sample_bytes > self.PREGATHER_MAX_BYTES:
+            return None
+        return (jnp.take(images_all, idx, axis=0),
+                jnp.take(labels_all, idx, axis=0))
+
+    def _epoch_keys(self, state: TrainState, key: jax.Array, n: int):
+        """(aug_keys, dropout_keys), each (n, 2) u32 — the same values
+        _train_step would derive per step, batched into one threefry."""
+        step_keys = jax.vmap(
+            lambda i: jax.random.fold_in(key, state.step + i)
+        )(jnp.arange(n, dtype=jnp.int32))
+        pairs = jax.vmap(jax.random.split)(step_keys)  # (n, 2, key)
+        return pairs[:, 0], pairs[:, 1]
+
     def _train_epoch(self, state: TrainState, images_all, labels_all,
                      idx, valid, key: jax.Array
                      ) -> Tuple[TrainState, Dict[str, jax.Array]]:
         """idx/valid: (steps, global_batch) — the sampler's epoch plan."""
+        aug_keys, dropout_keys = self._epoch_keys(state, key, idx.shape[0])
+        pre = self._pregather(images_all, labels_all, idx)
 
-        def body(st, xs):
-            ids, v = xs
-            return self._train_step(st, jnp.take(images_all, ids, axis=0),
-                                    jnp.take(labels_all, ids, axis=0),
-                                    v, key)
+        def pack(step_out):
+            # ONE stacked ys leaf per step instead of three scalar leaves:
+            # each scan output leaf costs a dynamic-update-slice per
+            # iteration in the loop body.
+            st, m = step_out
+            return st, jnp.stack([m["loss"], m["correct"], m["valid"]])
 
-        return jax.lax.scan(body, state, (idx, valid))
+        if pre is not None:
+            def body(st, xs):
+                im, lb, v, ak, dk = xs
+                return pack(self._train_step_keys(st, im, lb, v, ak, dk))
+
+            state, packed = jax.lax.scan(
+                body, state, (*pre, valid, aug_keys, dropout_keys))
+        else:
+            def body(st, xs):
+                ids, v, ak, dk = xs
+                return pack(self._train_step_keys(
+                    st, jnp.take(images_all, ids, axis=0),
+                    jnp.take(labels_all, ids, axis=0), v, ak, dk))
+
+            state, packed = jax.lax.scan(
+                body, state, (idx, valid, aug_keys, dropout_keys))
+        return state, {"loss": packed[:, 0], "correct": packed[:, 1],
+                       "valid": packed[:, 2]}
 
     def _eval_epoch(self, state: TrainState, images_all, labels_all,
                     idx, valid) -> Dict[str, jax.Array]:
+        zeros = {k: jnp.zeros((), jnp.float32)
+                 for k in ("loss_numer", "loss_denom", "correct", "valid")}
+        pre = self._pregather(images_all, labels_all, idx)
+        if pre is not None:
+            def body(carry, xs):
+                im, lb, v = xs
+                m = self._eval_step(state, im, lb, v)
+                return jax.tree_util.tree_map(jnp.add, carry, m), None
+
+            totals, _ = jax.lax.scan(body, zeros, (*pre, valid))
+            return totals
+
         def body(carry, xs):
             ids, v = xs
             m = self._eval_step(state, jnp.take(images_all, ids, axis=0),
                                 jnp.take(labels_all, ids, axis=0), v)
             return jax.tree_util.tree_map(jnp.add, carry, m), None
 
-        zeros = {k: jnp.zeros((), jnp.float32)
-                 for k in ("loss_numer", "loss_denom", "correct", "valid")}
         totals, _ = jax.lax.scan(body, zeros, (idx, valid))
         return totals
 

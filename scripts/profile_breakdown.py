@@ -1,0 +1,218 @@
+#!/usr/bin/env python3
+"""Where does the cnn/b64 step's time go?  (VERDICT round-2 item #1)
+
+Builds the exact headline-bench program (resident loader, cnn, batch 64,
+synthetic corpus, seed 1234) and times a ladder of partial programs, each a
+jitted lax.scan over the same epoch plan:
+
+  gather            index-gather of the batch from the resident corpus
+  + augment         + the fused affine-warp train transform
+  + forward         + model apply (train mode) and loss
+  + backward        + value_and_grad (no optimizer)
+  full step         the real train_epoch (adds adam update + metrics)
+
+Stage-to-stage deltas attribute the time.  Every program consumes its
+result into a scalar carry so XLA cannot dead-code anything.  Run on the
+TPU (default backend); writes PROFILE_BREAKDOWN.json at the repo root and
+prints one human-readable table to stderr.
+
+Usage: python scripts/profile_breakdown.py [--batch 64] [--steps 2814]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--steps", type=int, default=0,
+                   help="scan length; 0 = 3 fused epochs like the bench")
+    p.add_argument("--model", default="cnn")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from bench import peak_flops, _make_corpus
+    from distributedpytorch_tpu import runtime, utils
+    from distributedpytorch_tpu.data import augment
+    from distributedpytorch_tpu.data.pipeline import ResidentLoader
+    from distributedpytorch_tpu.models import get_model, get_model_input_size
+    from distributedpytorch_tpu.ops import flops as flops_mod
+    from distributedpytorch_tpu.ops.losses import get_loss_fn
+    from distributedpytorch_tpu.train.engine import Engine, make_optimizer
+
+    mesh = runtime.make_mesh()
+    dataset = _make_corpus(28, 1, 60000)
+    loader = ResidentLoader(dataset.splits["train"], mesh, args.batch,
+                            shuffle=True, seed=1234)
+    model = get_model(args.model, dataset.nb_classes)
+    tx = make_optimizer("adam", 1e-3, 0.9, 0.1, len(loader), False)
+    engine = Engine(model, args.model, get_loss_fn("cross_entropy"), tx,
+                    dataset.mean, dataset.std,
+                    get_model_input_size(args.model))
+    state = jax.device_put(
+        engine.init_state(utils.root_key(1234), dataset.channels),
+        runtime.replicated_sharding(mesh))
+    key = utils.root_key(1234)
+
+    if args.steps <= 0:
+        plans = [loader.epoch_plan(e) for e in range(3)]
+        idx = jax.device_put(
+            np.concatenate([jax.device_get(pl[0]) for pl in plans]),
+            loader.plan_sharding)
+        valid = jax.device_put(
+            np.concatenate([jax.device_get(pl[1]) for pl in plans]),
+            loader.plan_sharding)
+    else:
+        idx, valid = loader.epoch_plan(0)
+        idx, valid = idx[:args.steps], valid[:args.steps]
+    n_steps = int(idx.shape[0])
+    images_all, labels_all = loader.images, loader.labels
+    mean, std = engine.mean, engine.std
+    out_dim = engine.input_size
+    cdt = engine.compute_dtype
+
+    # roofline inputs BEFORE the timed runs: train_epoch donates its state
+    # argument, so the original state buffers are gone afterwards.
+    device_kind = jax.devices()[0].device_kind
+    peak = peak_flops(device_kind)
+    host_params = jax.device_get(state.params)
+    host_bs = jax.device_get(state.batch_stats)
+    gb = loader.global_batch
+    fps = flops_mod.train_flops_per_sample(
+        engine.model, host_params, host_bs, batch=gb, input_size=out_dim)
+    n_params = sum(int(np.prod(np.shape(l)))
+                   for l in jax.tree_util.tree_leaves(host_params))
+
+    # --- the ladder of partial programs (each: scan, scalar carry) -------
+
+    def stage_empty(acc, xs):
+        ids, v = xs
+        return acc + jnp.sum(v) + jnp.sum(ids) * 0, None
+
+    def stage_gather(acc, xs):
+        ids, v = xs
+        im = jnp.take(images_all, ids, axis=0)
+        lb = jnp.take(labels_all, ids, axis=0)
+        return acc + jnp.sum(im.astype(jnp.float32)) + jnp.sum(lb) \
+            + jnp.sum(v), None
+
+    def stage_augment(acc, xs):
+        ids, v = xs
+        im = jnp.take(images_all, ids, axis=0)
+        lb = jnp.take(labels_all, ids, axis=0)
+        aug = augment.train_transform(key, im, mean, std, out_dim,
+                                      out_dtype=cdt)
+        return acc + jnp.sum(aug.astype(jnp.float32)) + jnp.sum(lb) \
+            + jnp.sum(v), None
+
+    def _loss_of(params, ids, v):
+        im = jnp.take(images_all, ids, axis=0)
+        lb = jnp.take(labels_all, ids, axis=0)
+        aug = augment.train_transform(key, im, mean, std, out_dim,
+                                      out_dtype=cdt)
+        out, _ = engine._apply(params, state.batch_stats, aug, True, key)
+        vmask = v.astype(jnp.float32)
+        return engine._reduce_loss(out, lb, vmask)
+
+    def stage_forward(acc, xs):
+        ids, v = xs
+        return acc + _loss_of(state.params, ids, v), None
+
+    def stage_backward(acc, xs):
+        ids, v = xs
+        loss, grads = jax.value_and_grad(_loss_of)(state.params, ids, v)
+        g0 = sum(jnp.sum(g) for g in jax.tree_util.tree_leaves(grads))
+        return acc + loss + g0 * 0.0, None
+
+    def run_scan(body):
+        fn = jax.jit(lambda: jax.lax.scan(body, jnp.zeros(()),
+                                          (idx, valid))[0])
+        fn().block_until_ready()  # compile + warmup
+        t0 = time.monotonic()
+        fn().block_until_ready()
+        return (time.monotonic() - t0) / n_steps
+
+    results = {}
+    for name, body in [("empty_scan", stage_empty),
+                       ("gather", stage_gather),
+                       ("gather_augment", stage_augment),
+                       ("gather_augment_fwd", stage_forward),
+                       ("gather_augment_fwd_bwd", stage_backward)]:
+        per_step = run_scan(body)
+        results[name] = per_step
+        log(f"{name:26s} {per_step * 1e6:8.1f} us/step")
+
+    # full program: the real train_epoch (AOT-compiled like the bench)
+    compiled = engine.train_epoch.lower(
+        state, images_all, labels_all, idx, valid, key).compile()
+    st, m = compiled(state, images_all, labels_all, idx, valid, key)
+    jax.block_until_ready(m["loss"])
+    t0 = time.monotonic()
+    st, m = compiled(st, images_all, labels_all, idx, valid, key)
+    jax.block_until_ready(m["loss"])
+    results["full_step"] = (time.monotonic() - t0) / n_steps
+    log(f"{'full_step':26s} {results['full_step'] * 1e6:8.1f} us/step")
+
+    # attribution by deltas
+    breakdown = {
+        "scan_overhead_us": results["empty_scan"] * 1e6,
+        "gather_us": (results["gather"] - results["empty_scan"]) * 1e6,
+        "augment_us": (results["gather_augment"] - results["gather"]) * 1e6,
+        "forward_us": (results["gather_augment_fwd"]
+                       - results["gather_augment"]) * 1e6,
+        "backward_us": (results["gather_augment_fwd_bwd"]
+                        - results["gather_augment_fwd"]) * 1e6,
+        "optimizer_metrics_us": (results["full_step"]
+                                 - results["gather_augment_fwd_bwd"]) * 1e6,
+        "full_step_us": results["full_step"] * 1e6,
+    }
+
+    # roofline context
+    ideal_us = fps * gb / peak * 1e6 if peak else None
+    out = {
+        "model": args.model, "batch": args.batch, "steps": n_steps,
+        "device_kind": device_kind,
+        "stage_us_per_step": {k: round(v * 1e6, 2)
+                              for k, v in results.items()},
+        "breakdown_us": {k: round(v, 2) for k, v in breakdown.items()},
+        "train_flops_per_step": fps * gb,
+        "ideal_matmul_us_at_peak": round(ideal_us, 2) if ideal_us else None,
+        "mfu": (fps * gb / (results["full_step"] * peak)) if peak else None,
+        "n_params": n_params,
+    }
+    log("")
+    log(f"breakdown (us/step, batch {gb}, {device_kind}):")
+    for k, v in breakdown.items():
+        log(f"  {k:24s} {v:8.1f}")
+    if ideal_us:
+        log(f"  {'ideal_at_peak':24s} {ideal_us:8.1f}   "
+            f"(analytic FLOPs / {peak / 1e12:.0f} TF/s)")
+        log(f"  MFU {out['mfu'] * 100:.1f}%")
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "PROFILE_BREAKDOWN.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    log(f"wrote {path}")
+    print(json.dumps(out["breakdown_us"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

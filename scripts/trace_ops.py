@@ -1,0 +1,126 @@
+#!/usr/bin/env python3
+"""Op-level time attribution for the headline train step.
+
+Captures a jax.profiler device trace of the resident cnn/b64 epoch program
+and aggregates device-op durations by HLO op name from the Chrome-trace
+JSON the profiler writes — no tensorboard needed.  Prints the top ops by
+total device time.  Companion to scripts/profile_breakdown.py (stage-level
+deltas); this one answers "which HLO inside the step".
+
+Usage: python scripts/trace_ops.py [--steps 400] [--batch 64] [--top 40]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--steps", type=int, default=400)
+    p.add_argument("--model", default="cnn")
+    p.add_argument("--top", type=int, default=40)
+    args = p.parse_args()
+
+    import jax
+
+    from bench import _make_corpus
+    from distributedpytorch_tpu import runtime, utils
+    from distributedpytorch_tpu.data.pipeline import ResidentLoader
+    from distributedpytorch_tpu.models import get_model, get_model_input_size
+    from distributedpytorch_tpu.ops.losses import get_loss_fn
+    from distributedpytorch_tpu.train.engine import Engine, make_optimizer
+
+    mesh = runtime.make_mesh()
+    dataset = _make_corpus(28, 1, 60000)
+    loader = ResidentLoader(dataset.splits["train"], mesh, args.batch,
+                            shuffle=True, seed=1234)
+    model = get_model(args.model, dataset.nb_classes)
+    tx = make_optimizer("adam", 1e-3, 0.9, 0.1, len(loader), False)
+    engine = Engine(model, args.model, get_loss_fn("cross_entropy"), tx,
+                    dataset.mean, dataset.std,
+                    get_model_input_size(args.model))
+    state = jax.device_put(
+        engine.init_state(utils.root_key(1234), dataset.channels),
+        runtime.replicated_sharding(mesh))
+    key = utils.root_key(1234)
+    idx, valid = loader.epoch_plan(0)
+    idx, valid = idx[:args.steps], valid[:args.steps]
+
+    compiled = engine.train_epoch.lower(
+        state, loader.images, loader.labels, idx, valid, key).compile()
+    st, m = compiled(state, loader.images, loader.labels, idx, valid, key)
+    jax.block_until_ready(m["loss"])  # warmup outside the trace
+
+    tmpdir = tempfile.mkdtemp(prefix="dpt_trace_")
+    jax.profiler.start_trace(tmpdir)
+    st, m = compiled(st, loader.images, loader.labels, idx, valid, key)
+    jax.block_until_ready(m["loss"])
+    jax.profiler.stop_trace()
+
+    files = glob.glob(os.path.join(
+        tmpdir, "**", "*.trace.json.gz"), recursive=True)
+    if not files:
+        log(f"no trace json found under {tmpdir}")
+        return 1
+
+    # Aggregate complete events from device lanes (pid names with 'TPU' /
+    # 'Chip'/'device'), skipping host python threads.
+    by_op = collections.Counter()
+    total = 0.0
+    for path in files:
+        with gzip.open(path, "rt") as f:
+            trace = json.load(f)
+        events = trace.get("traceEvents", [])
+        pid_names = {e["pid"]: e["args"].get("name", "")
+                     for e in events
+                     if e.get("ph") == "M" and e.get("name") == "process_name"
+                     and "args" in e}
+        device_pids = {pid for pid, name in pid_names.items()
+                       if re.search(r"(tpu|chip|device|/device:)",
+                                    name, re.I)
+                       and not re.search(r"host", name, re.I)}
+        for e in events:
+            if e.get("ph") != "X" or e.get("pid") not in device_pids:
+                continue
+            dur = float(e.get("dur", 0.0))
+            name = e.get("name", "?")
+            by_op[name] += dur
+            total += dur
+    if not by_op:
+        log("no device events matched; pid names were: "
+            + ", ".join(sorted(set(pid_names.values()))))
+        return 1
+
+    n = args.steps
+    log(f"device op time over {n} steps (us/step), total "
+        f"{total / n:.1f} us/step:")
+    rows = []
+    for name, dur in by_op.most_common(args.top):
+        rows.append({"op": name, "us_per_step": round(dur / n, 2),
+                     "pct": round(100 * dur / total, 1)})
+        log(f"  {dur / n:8.2f} us  {100 * dur / total:5.1f}%  {name[:90]}")
+    print(json.dumps({"total_us_per_step": round(total / n, 2),
+                      "top": rows}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,66 @@
+"""ops/pooling.py: max_pool_2x2 must be bit-identical to flax nn.max_pool
+in forward AND backward (first-max gradient routing), ties included."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.ops.pooling import max_pool_2x2
+
+
+def _ref_pool(x):
+    return nn.max_pool(x, (2, 2), strides=(2, 2))
+
+
+def test_forward_matches_flax():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 12, 16))
+    np.testing.assert_array_equal(np.asarray(max_pool_2x2(x)),
+                                  np.asarray(_ref_pool(x)))
+
+
+def test_backward_matches_flax_random():
+    # random values: no ties, gradients must agree exactly
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 6, 6, 8))
+    w = jax.random.normal(jax.random.PRNGKey(2), (2, 3, 3, 8))
+
+    g_fast = jax.grad(lambda y: jnp.sum(max_pool_2x2(y) * w))(x)
+    g_ref = jax.grad(lambda y: jnp.sum(_ref_pool(y) * w))(x)
+    np.testing.assert_array_equal(np.asarray(g_fast), np.asarray(g_ref))
+
+
+def test_backward_tie_first_max_wins():
+    # all-equal window: the FIRST element in row-major order takes the
+    # whole gradient (torch MaxPool2d / XLA select-and-scatter semantics)
+    x = jnp.ones((1, 2, 2, 1), jnp.float32)
+    g = jax.grad(lambda y: jnp.sum(max_pool_2x2(y)) * 3.0)(x)
+    np.testing.assert_allclose(np.asarray(g)[0, :, :, 0],
+                               [[3.0, 0.0], [0.0, 0.0]])
+    # and it matches the flax op's routing on the same tie
+    g_ref = jax.grad(lambda y: jnp.sum(_ref_pool(y)) * 3.0)(x)
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(g_ref))
+
+
+def test_backward_partial_tie():
+    # tie between positions (0,1) and (1,0); (0,1) is first in row-major
+    x = jnp.array([[[0.0], [5.0]],
+                   [[5.0], [1.0]]], jnp.float32)[None]
+    g = jax.grad(lambda y: jnp.sum(max_pool_2x2(y)))(x)
+    np.testing.assert_allclose(np.asarray(g)[0, :, :, 0],
+                               [[0.0, 1.0], [0.0, 0.0]])
+    g_ref = jax.grad(lambda y: jnp.sum(_ref_pool(y)))(x)
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(g_ref))
+
+
+def test_bfloat16_dtype_preserved():
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 4, 4, 4), jnp.bfloat16)
+    out = max_pool_2x2(x)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(out, np.float32), np.asarray(_ref_pool(x), np.float32))
+
+
+def test_odd_spatial_raises():
+    with pytest.raises(ValueError, match="even"):
+        max_pool_2x2(jnp.zeros((1, 5, 4, 1)))
