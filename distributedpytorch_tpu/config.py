@@ -53,7 +53,8 @@ MODEL_CHOICES = (
 )
 OPTIMIZER_CHOICES = ("adam", "SGD")
 LOSS_CHOICES = ("cross_entropy", "weighted_cross_entropy", "focal_loss")
-DATASET_CHOICES = ("mnist", "fashion_mnist", "cifar10", "synthetic")
+DATASET_CHOICES = ("mnist", "fashion_mnist", "cifar10", "synthetic",
+                   "synthetic_hard")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -107,6 +108,14 @@ class Config:
     # param/optimizer tensors over the 'model' axis (ZeRO/FSDP-style,
     # see parallel.py).  1 = pure data parallelism (reference semantics).
     model_parallel: int = 1
+    # 'full': fused softmax attention on each device (default);
+    # 'ring': sequence-parallel ring attention over the 'model' mesh axis
+    # (vit only, needs model_parallel >= 2 — see ops/attention.py).
+    attention: str = "full"
+    # Megatron-style tensor parallelism for vit: attention heads + MLP
+    # hidden sharded over 'model' with SHARDED ACTIVATIONS (parallel.py
+    # strategy 2).  Needs model_parallel >= 2; exclusive with ring.
+    tensor_parallel: bool = False
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -178,6 +187,18 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                    help="shard large param/optimizer tensors over an "
                         "N-way 'model' mesh axis (must divide the device "
                         "count; default 1 = replicated)")
+    p.add_argument("--attention", choices=("full", "ring"),
+                   default="full",
+                   help="attention implementation for --model vit: fused "
+                        "softmax (default) or sequence-parallel ring "
+                        "attention over the 'model' mesh axis (requires "
+                        "--model-parallel >= 2)")
+    p.add_argument("--tensor-parallel", action="store_true",
+                   dest="tensorParallel",
+                   help="Megatron-style tensor parallelism for --model "
+                        "vit: heads + MLP hidden sharded over the 'model' "
+                        "mesh axis with sharded activations (requires "
+                        "--model-parallel >= 2)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -230,4 +251,6 @@ def config_from_argv(argv=None) -> Config:
         grad_accum=args.gradAccum,
         ckpt_format=args.ckptFormat,
         model_parallel=args.modelParallel,
+        attention=args.attention,
+        tensor_parallel=args.tensorParallel,
     )

@@ -50,12 +50,56 @@ AUX_LOGIT_MODELS = frozenset({"inception"})
 DROPOUT_MODELS = frozenset({"alexnet", "vgg", "squeezenet", "inception"})
 
 
-def get_model(name: str, num_classes: int,
-              half_precision: bool = True) -> nn.Module:
+def _require_model_axis(mesh, what: str) -> None:
+    from ..runtime import MODEL_AXIS
+
+    if mesh is None or MODEL_AXIS not in mesh.shape \
+            or mesh.shape[MODEL_AXIS] < 2:
+        raise ValueError(
+            f"{what} uses the mesh's 'model' axis: pass "
+            "--model-parallel >= 2 (and a mesh)")
+
+
+def get_model(name: str, num_classes: int, half_precision: bool = True,
+              attention: str = "full", mesh=None,
+              tensor_parallel: bool = False) -> nn.Module:
+    """``attention``: 'full' (default, XLA-fused softmax attention) or
+    'ring' (sequence-parallel over ``mesh``'s 'model' axis via
+    lax.ppermute — ops/attention.py).  ``tensor_parallel``: Megatron-style
+    sharded-activation TP over the same axis (parallel.make_tp_constrain).
+    Both are vit-family features; requesting them for a CNN is a user
+    error surfaced the CLI way (ValueError -> log-and-exit)."""
     if name not in MODEL_REGISTRY:
         raise ValueError(f"Invalid model name {name!r} "
                          f"(choices: {sorted(MODEL_REGISTRY)})")
+    if attention not in ("full", "ring"):
+        raise ValueError(f"attention must be 'full' or 'ring', "
+                         f"got {attention!r}")
     dtype = jnp.bfloat16 if half_precision else jnp.float32
+    if attention == "ring" or tensor_parallel:
+        if name != "vit":
+            feature = ("--attention ring" if attention == "ring"
+                       else "--tensor-parallel")
+            raise ValueError(
+                f"{feature} applies to the attention model family "
+                f"only (--model vit); {name!r} has no attention")
+        if attention == "ring" and tensor_parallel:
+            raise ValueError(
+                "--attention ring and --tensor-parallel both shard over "
+                "the 'model' axis (sequence vs heads) — pick one")
+        from .vit import ViT
+
+        if attention == "ring":
+            from ..ops.attention import make_ring_attention
+
+            _require_model_axis(mesh, "--attention ring (token axis)")
+            return ViT(num_classes=num_classes, dtype=dtype,
+                       attention_fn=make_ring_attention(mesh))
+        from ..parallel import make_tp_constrain
+
+        _require_model_axis(mesh, "--tensor-parallel (head/hidden axes)")
+        return ViT(num_classes=num_classes, dtype=dtype,
+                   tp_constrain=make_tp_constrain(mesh))
     return MODEL_REGISTRY[name](num_classes, dtype)
 
 

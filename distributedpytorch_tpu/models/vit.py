@@ -15,7 +15,13 @@ attention in ops/attention.py.  Built TPU-first:
     softmax attention (XLA's flash kernels on TPU); passing a closure over
     ``ops.attention.ring_attention`` runs the same model sequence-parallel
     for sequences too long for one device (tests/test_attention.py pins
-    the two paths equal).
+    the two paths equal);
+  * ``tp_constrain`` is injectable (parallel.make_tp_constrain): when set,
+    activation sharding constraints pin attention heads and the MLP hidden
+    axis to the 'model' mesh axis — Megatron-style tensor parallelism with
+    GSPMD doing the matmul partitioning and inserting the per-block
+    all-reduce (see parallel.py's strategy-2 docs).  Constraints never
+    change the math, only the layout (tests/test_tensor_parallel.py).
 """
 
 from __future__ import annotations
@@ -26,8 +32,10 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from ..ops.attention import full_attention
+from ..runtime import DATA_AXIS, MODEL_AXIS
 
 AttentionFn = Callable[..., jnp.ndarray]  # (q, k, v) -> out, all (B,S,H,D)
+ConstrainFn = Callable[..., jnp.ndarray]  # (x, partition-spec tuple) -> x
 
 
 class TransformerBlock(nn.Module):
@@ -36,27 +44,38 @@ class TransformerBlock(nn.Module):
     mlp_ratio: int
     dtype: Any
     attention_fn: AttentionFn
+    tp_constrain: Optional[ConstrainFn] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         b, s, _ = x.shape
         head_dim = self.dim // self.heads
+        tp = self.tp_constrain or (lambda a, _spec: a)
 
         h = nn.LayerNorm(dtype=self.dtype)(x)
         qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, s, self.heads, head_dim)
-        k = k.reshape(b, s, self.heads, head_dim)
-        v = v.reshape(b, s, self.heads, head_dim)
+        # Heads on MODEL_AXIS: the qkv matmul becomes column-parallel
+        # (each device computes its own heads' slice) and attention runs
+        # fully locally per head-shard.
+        spec_bshd = (DATA_AXIS, None, MODEL_AXIS, None)
+        q = tp(q.reshape(b, s, self.heads, head_dim), spec_bshd)
+        k = tp(k.reshape(b, s, self.heads, head_dim), spec_bshd)
+        v = tp(v.reshape(b, s, self.heads, head_dim), spec_bshd)
         attn = self.attention_fn(q, k, v).reshape(b, s, self.dim)
+        # proj is then row-parallel; the residual sum is the block's one
+        # all-reduce point (GSPMD inserts it to satisfy this constraint).
         x = x + nn.Dense(self.dim, dtype=self.dtype, name="proj")(attn)
+        x = tp(x, (DATA_AXIS, None, None))
 
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype,
                      name="mlp_up")(h)
-        h = nn.gelu(h)
+        # MLP hidden on MODEL_AXIS: column-parallel up, row-parallel down.
+        h = tp(nn.gelu(h), (DATA_AXIS, None, MODEL_AXIS))
         h = nn.Dense(self.dim, dtype=self.dtype, name="mlp_down")(h)
-        return x + h
+        x = x + h
+        return tp(x, (DATA_AXIS, None, None))
 
 
 class ViT(nn.Module):
@@ -71,6 +90,7 @@ class ViT(nn.Module):
     mlp_ratio: int = 4
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[AttentionFn] = None
+    tp_constrain: Optional[ConstrainFn] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -86,7 +106,7 @@ class ViT(nn.Module):
         x = x + pos.astype(self.dtype)
         for i in range(self.depth):
             x = TransformerBlock(self.dim, self.heads, self.mlp_ratio,
-                                 self.dtype, attn_fn,
+                                 self.dtype, attn_fn, self.tp_constrain,
                                  name=f"block{i}")(x, train=train)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         x = jnp.mean(x, axis=1)  # mean-pool tokens

@@ -52,22 +52,29 @@ _MASKED = -1e30
 
 
 def _ring_body(carry, _, *, axis_name: str, n_dev: int, scale: float,
-               q_pos, causal: bool):
+               q_pos, causal: bool, kv_valid):
     """One ring step: attend local Q against the currently-held K/V block,
     merge into the running flash accumulator, rotate K/V (+ positions) to
-    the next device."""
+    the next device.  ``kv_valid`` (static int or None) masks padded key
+    positions >= kv_valid — the ragged-sequence support that lets callers
+    pad S up to a multiple of the ring size (see make_ring_attention)."""
     k_cur, v_cur, k_pos, acc, m, l = carry
 
     scores = jnp.einsum("bqhd,bkhd->bhqk", q_pos[1], k_cur) * scale
+    mask = None
     if causal:
         mask = (q_pos[0][:, None] >= k_pos[None, :])[None, None]
+    if kv_valid is not None:
+        kv_mask = (k_pos < kv_valid)[None, None, None, :]
+        mask = kv_mask if mask is None else mask & kv_mask
+    if mask is not None:
         scores = jnp.where(mask, scores, _MASKED)
 
     m_blk = jnp.max(scores, axis=-1)
     m_new = jnp.maximum(m, m_blk)
     alpha = jnp.exp(m - m_new)
     p = jnp.exp(scores - m_new[..., None])
-    if causal:
+    if mask is not None:
         p = jnp.where(mask, p, 0.0)  # masked entries contribute exactly 0
     l_new = l * alpha + jnp.sum(p, axis=-1)
     acc_new = (acc * alpha[..., None]
@@ -81,7 +88,7 @@ def _ring_body(carry, _, *, axis_name: str, n_dev: int, scale: float,
 
 
 def _ring_attention_local(q, k, v, *, axis_name: str, n_dev: int,
-                          s_local: int, causal: bool):
+                          s_local: int, causal: bool, kv_valid):
     """Per-device body (runs under shard_map): q/k/v are the LOCAL blocks
     (B, S_local, H, D); returns the local output block."""
     dtype = q.dtype
@@ -104,35 +111,45 @@ def _ring_attention_local(q, k, v, *, axis_name: str, n_dev: int,
     l = qt[..., 0] * 0.0
 
     body = functools.partial(_ring_body, axis_name=axis_name, n_dev=n_dev,
-                             scale=scale, q_pos=(q_glob, qf), causal=causal)
+                             scale=scale, q_pos=(q_glob, qf), causal=causal,
+                             kv_valid=kv_valid)
     (_, _, _, acc, m, l), _ = jax.lax.scan(
         body, (kf, vf, k_pos, acc, m, l), None, length=n_dev)
 
+    # Fully-masked rows (padded queries) have l == 0 -> output exactly 0.
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return jnp.einsum("bhqd->bqhd", out).astype(dtype)
 
 
-def _seq_spec(mesh: Mesh, axis_name: str) -> P:
+def _seq_spec(mesh: Mesh, axis_name: str, shard_batch: bool = True) -> P:
     """(B, S, H, D) partition spec: S over the sequence axis, B over the
-    single remaining data axis when there is exactly one."""
+    single remaining data axis when there is exactly one (and the caller's
+    batch is divisible by it — init-time dummy batches are not)."""
     data_axes = tuple(a for a in mesh.axis_names if a != axis_name)
-    batch_spec = data_axes[0] if len(data_axes) == 1 else None
+    batch_spec = (data_axes[0]
+                  if shard_batch and len(data_axes) == 1 else None)
     return P(batch_spec, axis_name, None, None)
 
 
 @functools.lru_cache(maxsize=32)
 def _ring_jitted(mesh: Mesh, axis_name: str, n_dev: int, s_local: int,
-                 causal: bool):
-    spec = _seq_spec(mesh, axis_name)
+                 causal: bool, kv_valid, shard_batch: bool):
+    spec = _seq_spec(mesh, axis_name, shard_batch)
     fn = functools.partial(_ring_attention_local, axis_name=axis_name,
-                           n_dev=n_dev, s_local=s_local, causal=causal)
+                           n_dev=n_dev, s_local=s_local, causal=causal,
+                           kv_valid=kv_valid)
     return jax.jit(jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
 
 
+def _batch_shardable(mesh: Mesh, axis_name: str, b: int) -> bool:
+    data_axes = tuple(a for a in mesh.axis_names if a != axis_name)
+    return len(data_axes) == 1 and b % mesh.shape[data_axes[0]] == 0
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    axis_name: str = "model", causal: bool = False,
-                   ) -> jax.Array:
+                   kv_valid: int = None) -> jax.Array:
     """Sequence-parallel attention over `mesh`'s `axis_name` axis.
 
     q/k/v: GLOBAL (B, S, H, D) arrays with S sharded over `axis_name`
@@ -142,19 +159,54 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     is 2 x (S/n) x H x D per step x n steps of neighbor `ppermute` — the
     all-to-all-free pattern that rides ICI neighbor links.
 
-    The jitted shard_map program is cached on (mesh, axis, shape, causal),
-    so repeated calls (e.g. every ViT block, every step) are cache hits.
+    ``kv_valid`` (static) masks key positions >= kv_valid, so callers may
+    zero-pad S up to a multiple of the ring size and still get exactly
+    full_attention's result on the first kv_valid positions
+    (make_ring_attention packages that pattern).
+
+    The jitted shard_map program is cached on (mesh, axis, shape, causal,
+    kv_valid), so repeated calls (e.g. every ViT block, every step) are
+    cache hits.
     """
     n_dev = mesh.shape[axis_name]
     s = q.shape[1]
     if s % n_dev:
         raise ValueError(f"sequence length {s} not divisible by "
                          f"{axis_name} axis size {n_dev}")
-    return _ring_jitted(mesh, axis_name, n_dev, s // n_dev, causal)(q, k, v)
+    if kv_valid is not None and not 0 < kv_valid <= s:
+        raise ValueError(f"kv_valid={kv_valid} out of range (0, {s}]")
+    return _ring_jitted(mesh, axis_name, n_dev, s // n_dev, causal,
+                        kv_valid,
+                        _batch_shardable(mesh, axis_name, q.shape[0])
+                        )(q, k, v)
+
+
+def make_ring_attention(mesh: Mesh, axis_name: str = "model",
+                        causal: bool = False):
+    """An ``attention_fn`` closure for models (models/vit.py): pads the
+    token axis up to a multiple of the ring size, runs ring attention with
+    the padded keys masked (kv_valid), and slices the padding back off —
+    so ANY sequence length works, and the result equals full_attention on
+    the real tokens (ViT at 28x28/patch-4 has 49 tokens; the 8-device ring
+    pads to 56).  This is what the CLI's ``--attention ring`` installs."""
+    n_dev = mesh.shape[axis_name]
+
+    def attn(q, k, v):
+        s = q.shape[1]
+        pad = (-s) % n_dev
+        if pad == 0:
+            return ring_attention(q, k, v, mesh, axis_name, causal=causal)
+        width = ((0, 0), (0, pad), (0, 0), (0, 0))
+        out = ring_attention(
+            jnp.pad(q, width), jnp.pad(k, width), jnp.pad(v, width),
+            mesh, axis_name, causal=causal, kv_valid=s)
+        return out[:, :s]
+
+    return attn
 
 
 def sequence_sharding(mesh: Mesh, axis_name: str = "model"
                       ) -> NamedSharding:
     """Sharding for (B, S, H, D) activations: S over the sequence axis,
     B over 'data' when present."""
-    return NamedSharding(mesh, _seq_spec(mesh, axis_name))
+    return NamedSharding(mesh, _seq_spec(mesh, axis_name, True))

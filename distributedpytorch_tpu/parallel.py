@@ -1,18 +1,35 @@
-"""Parameter/optimizer sharding over the 2-D (data, model) mesh.
+"""Model-axis parallelism over the 2-D (data, model) mesh — TWO distinct
+strategies behind the same 'model' axis:
 
-The reference's only strategy is data parallelism (SURVEY §2 parallelism
-checklist): params replicated, gradients all-reduced.  This module adds the
-TPU-native extension on top of the same mesh (runtime.make_mesh's 'model'
-axis): shard large parameter tensors — and, because the rule is purely
-shape-driven, their optimizer moments — across MODEL_AXIS.  Under jit, XLA
-(GSPMD) inserts the all-gathers/reduce-scatters needed around each matmul,
-so the train step's *math* is unchanged; only the layout is.  That is the
-compiler-native equivalent of ZeRO-3/FSDP: per-chip memory for sharded
-tensors drops by the model-axis size, at the cost of gather traffic on ICI.
+1. **ZeRO-3/FSDP-style parameter/optimizer sharding** (``state_sharding``,
+   what ``--model-parallel N`` alone enables): large parameter tensors —
+   and, because the rule is purely shape-driven, their optimizer moments —
+   are sharded across MODEL_AXIS.  Under jit, XLA (GSPMD) inserts the
+   all-gathers needed AROUND each matmul, so the step's *math* and its
+   *compute distribution* are unchanged; only the storage layout is.  This
+   buys per-chip parameter/optimizer memory (divided by the model-axis
+   size) at the cost of gather traffic on ICI — it is NOT compute
+   parallelism: every device still runs every matmul at full size, on
+   gathered weights, with fully-replicated activations.
+
+2. **Tensor parallelism** (``make_tp_constrain``, what ``--tensor-parallel``
+   adds for the vit family): Megatron-style sharded COMPUTE.  Activation
+   sharding constraints pin the attention-head and MLP-hidden axes to
+   MODEL_AXIS; GSPMD then partitions the matmuls themselves — each device
+   computes only its head/hidden slice (column-parallel up-projection,
+   row-parallel down-projection) and XLA inserts the one all-reduce per
+   block that Megatron-TP requires.  Per-device ACTIVATION memory and
+   per-device FLOPs both drop by the model-axis size; weights stay laid
+   out however (1) placed them — the two strategies compose.
+
+The reference has neither (SURVEY §2 parallelism checklist: TP ABSENT,
+ZeRO ABSENT; data parallelism is its only strategy) — both are TPU-native
+framework additions on the axis ``runtime.make_mesh`` reserves.
 
 Numerical equivalence with the replicated layout is proven in
-tests/test_parallel.py (same step, same batch, 1-D mesh vs 2-D
-data×model mesh, params bitwise-comparable to tolerance).
+tests/test_parallel.py (ZeRO) and tests/test_tensor_parallel.py (TP:
+logits equal with identical params; e2e training equal; per-device
+activation memory measured smaller).
 
 Usage:
     mesh = runtime.make_mesh(model_parallel=2)      # (data=4, model=2)
@@ -75,3 +92,26 @@ def state_sharding(state: Any, mesh: Mesh,
     opt_state + step).  Scalars and batch stats fall below the size floor
     and stay replicated automatically."""
     return tree_sharding(state, mesh, min_elements)
+
+
+def make_tp_constrain(mesh: Mesh):
+    """Activation-sharding hook for tensor parallelism (strategy 2 above).
+
+    Returns ``constrain(x, spec)`` applying
+    ``jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))``
+    — models thread it through their forward pass (models/vit.py
+    ``tp_constrain``) to pin head/hidden axes to MODEL_AXIS and the batch
+    axis to the data axis.  A constraint whose sharded dimension is not
+    divisible by its mesh-axis size is skipped (shape check is static at
+    trace time): that keeps tiny init-time dummy batches and odd eval
+    tails valid — GSPMD simply propagates its own choice there.
+    """
+
+    def constrain(x: jax.Array, spec) -> jax.Array:
+        for dim, axis in zip(x.shape, spec):
+            if axis is not None and dim % mesh.shape[axis]:
+                return x
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*spec)))
+
+    return constrain

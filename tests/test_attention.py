@@ -116,6 +116,30 @@ def test_vit_with_ring_attention_matches_default(seq_mesh):
                                rtol=2e-4, atol=2e-4)
 
 
+def test_make_ring_attention_ragged_matches_full(seq_mesh):
+    """The padding closure (what --attention ring installs): S=49 tokens
+    over an 8-way ring pads to 56 with masked keys — outputs AND grads
+    equal full attention on the real tokens."""
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q, k, v = (jax.random.normal(kk, (2, 49, 4, 16), jnp.float32)
+               for kk in ks)
+    attn = attention.make_ring_attention(seq_mesh)
+    want = attention.full_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(attn(q, k, v)), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    w = jax.random.normal(jax.random.PRNGKey(8), (2, 49, 4, 16))
+    g_full = jax.grad(
+        lambda a, b, c: jnp.sum(attention.full_attention(a, b, c) * w),
+        argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.grad(lambda a, b, c: jnp.sum(attn(a, b, c) * w),
+                      argnums=(0, 1, 2))(q, k, v)
+    for g, wv, name in zip(g_ring, g_full, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(wv),
+                                   rtol=5e-5, atol=5e-5,
+                                   err_msg=f"d{name} mismatch (ragged)")
+
+
 def test_ring_long_sequence(seq_mesh):
     """Long-context shape: S=2048 over 8 devices (256 per device) — the
     regime ring attention exists for; value-pinned to full attention."""

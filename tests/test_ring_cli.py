@@ -1,0 +1,81 @@
+"""Ring attention as a PRODUCT path (VERDICT round-2 item #3): the CLI's
+``--attention ring`` trains a ViT end-to-end through ``run_train`` on the
+(data, model) mesh, and the result pins to the identical run with fused
+full attention — same seed, same data, same sharded-parameter layout, the
+ONLY difference being the attention implementation."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.cli import run_test, run_train
+from distributedpytorch_tpu.config import Config
+from distributedpytorch_tpu.models import get_model
+from distributedpytorch_tpu import runtime
+
+
+def _cfg(tmp_path, name, **kw):
+    kw.setdefault("model_parallel", 2)
+    return Config(action="train", data_path="/tmp/nodata",
+                  rsl_path=str(tmp_path / name), dataset="synthetic",
+                  model_name="vit", batch_size=4, nb_epochs=1, debug=True,
+                  half_precision=False, **kw)
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("ring_cli")
+    full = run_train(_cfg(tmp_path, "full", attention="full"))
+    ring = run_train(_cfg(tmp_path, "ring", attention="ring"))
+    return tmp_path, full, ring
+
+
+def test_ring_cli_trains_to_same_params_as_full(trained):
+    _, full, ring = trained
+    fleaves = jax.tree_util.tree_leaves(
+        jax.device_get(full["state"].params))
+    rleaves = jax.tree_util.tree_leaves(
+        jax.device_get(ring["state"].params))
+    assert len(fleaves) == len(rleaves) > 0
+    for i, (f, r) in enumerate(zip(fleaves, rleaves)):
+        # flash-merge summation order differs from the fused softmax, so
+        # a trained epoch accumulates small drift (measured max ~5e-4 on
+        # ~1e-3-magnitude params); the tight per-step equivalence lives
+        # in test_attention.py, and the loss-history pin below stays 1e-3
+        np.testing.assert_allclose(
+            np.asarray(r), np.asarray(f), rtol=1e-2, atol=1.5e-3,
+            err_msg=f"param leaf {i}: ring-trained != full-trained")
+
+
+def test_ring_cli_history_matches_full(trained):
+    _, full, ring = trained
+    f, r = full["history"][0], ring["history"][0]
+    assert abs(f["train_loss"] - r["train_loss"]) < 1e-3
+    assert abs(f["valid_loss"] - r["valid_loss"]) < 1e-3
+
+
+def test_ring_checkpoint_tests_through_cli(trained):
+    tmp_path, full, ring = trained
+    import os
+
+    best = os.path.join(str(tmp_path / "ring"),
+                        "bestmodel-synthetic-vit.ckpt")
+    assert os.path.exists(best)
+    res = run_test(Config(
+        action="test", data_path="/tmp/nodata", rsl_path=str(tmp_path / "t"),
+        dataset="synthetic", checkpoint_file=best, debug=True,
+        half_precision=False, model_parallel=2, attention="ring"))
+    assert res["model_name"] == "vit"
+    assert 0.0 <= res["test_acc"] <= 1.0
+
+
+def test_ring_requires_vit():
+    with pytest.raises(ValueError, match="attention model family"):
+        get_model("cnn", 10, attention="ring",
+                  mesh=runtime.make_mesh(model_parallel=2))
+
+
+def test_ring_requires_model_axis(tmp_path):
+    with pytest.raises(ValueError, match="model-parallel"):
+        run_train(_cfg(tmp_path, "bad", attention="ring",
+                       model_parallel=1))

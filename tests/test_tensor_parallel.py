@@ -1,0 +1,104 @@
+"""Tensor parallelism for the vit family (VERDICT round-2 item #4):
+sharded-ACTIVATION Megatron-style TP via parallel.make_tp_constrain, as
+distinct from the ZeRO-style parameter sharding --model-parallel alone
+provides.  Pinned three ways on the 8-device virtual mesh:
+
+  1. identical params -> identical logits (constraints change layout,
+     never math);
+  2. e2e: run_train with --tensor-parallel equals the same run without;
+  3. the compiled train step's per-device temp (activation) memory is
+     measurably smaller with TP — the property ZeRO cannot provide.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu import parallel, runtime
+from distributedpytorch_tpu.cli import run_train
+from distributedpytorch_tpu.config import Config
+from distributedpytorch_tpu.models import get_model
+from distributedpytorch_tpu.models.vit import ViT
+
+
+def test_tp_logits_equal_plain():
+    mesh = runtime.make_mesh(model_parallel=4)  # (data=2, model=4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 28, 28, 3))
+    plain = ViT(num_classes=10, dtype=jnp.float32)
+    tp = ViT(num_classes=10, dtype=jnp.float32,
+             tp_constrain=parallel.make_tp_constrain(mesh))
+    params = plain.init({"params": jax.random.PRNGKey(1)}, x)["params"]
+    want = plain.apply({"params": params}, x)
+    got = jax.jit(lambda p, a: tp.apply({"params": p}, a))(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def _cfg(tmp_path, name, **kw):
+    return Config(action="train", data_path="/tmp/nodata",
+                  rsl_path=str(tmp_path / name), dataset="synthetic",
+                  model_name="vit", batch_size=4, nb_epochs=1, debug=True,
+                  half_precision=False, model_parallel=2, **kw)
+
+
+def test_tp_cli_trains_to_same_params(tmp_path):
+    base = run_train(_cfg(tmp_path, "base"))
+    tp = run_train(_cfg(tmp_path, "tp", tensor_parallel=True))
+    b = jax.tree_util.tree_leaves(jax.device_get(base["state"].params))
+    t = jax.tree_util.tree_leaves(jax.device_get(tp["state"].params))
+    assert len(b) == len(t) > 0
+    for i, (x, y) in enumerate(zip(b, t)):
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(x), rtol=2e-4, atol=2e-4,
+            err_msg=f"param leaf {i}: TP-trained != replicated-trained")
+
+
+def test_tp_requires_vit_and_model_axis():
+    mesh2 = runtime.make_mesh(model_parallel=2)
+    with pytest.raises(ValueError, match="attention model family"):
+        get_model("resnet", 10, tensor_parallel=True, mesh=mesh2)
+    with pytest.raises(ValueError, match="model-parallel"):
+        get_model("vit", 10, tensor_parallel=True,
+                  mesh=runtime.make_mesh())
+    with pytest.raises(ValueError, match="pick one"):
+        get_model("vit", 10, tensor_parallel=True, attention="ring",
+                  mesh=mesh2)
+
+
+def _compiled_train_memory(tp: bool) -> float:
+    """Per-device temp (activation/workspace) bytes of a compiled ViT
+    fwd+bwd step on the (data=2, model=4) mesh, sized so activations
+    dominate (dim 256, 196 tokens, batch 16)."""
+    mesh = runtime.make_mesh(model_parallel=4)
+    model = ViT(num_classes=10, patch=4, dim=256, depth=2, heads=8,
+                dtype=jnp.float32,
+                tp_constrain=parallel.make_tp_constrain(mesh) if tp
+                else None)
+    x = jnp.zeros((16, 56, 56, 3), jnp.float32)
+    params = jax.jit(model.init)({"params": jax.random.PRNGKey(0)},
+                                 x)["params"]
+    params = jax.device_put(params, runtime.replicated_sharding(mesh))
+    xs = jax.device_put(x, runtime.data_sharding(mesh))
+
+    def loss(p, a):
+        return jnp.sum(model.apply({"params": p}, a, train=True) ** 2)
+
+    compiled = jax.jit(jax.grad(loss)).lower(params, xs).compile()
+    mem = compiled.memory_analysis()
+    if mem is None:
+        pytest.skip("backend reports no memory analysis")
+    temp = getattr(mem, "temp_size_in_bytes", None)
+    if not temp:
+        pytest.skip("backend reports no temp size")
+    return float(temp)
+
+
+def test_tp_shrinks_activation_memory():
+    full = _compiled_train_memory(tp=False)
+    tp = _compiled_train_memory(tp=True)
+    # Megatron TP over 4-way 'model': head/hidden activations drop ~4x;
+    # require a conservative >=25% whole-step drop so the test stays
+    # robust to XLA workspace noise.
+    assert tp < 0.75 * full, \
+        f"TP temp {tp / 1e6:.1f} MB not < 75% of full {full / 1e6:.1f} MB"
