@@ -28,8 +28,12 @@ Two formats behind one API (``--ckpt-format``):
     AS-LAID-OUT, no gather, which is the TPU-native shape of
     checkpointing once --model-parallel states outgrow one host.  The
     five logical fields are preserved (meta.json + the state tree);
-    ``test -f DIR`` and resume work identically.  Validated single-host;
-    multi-host orbax coordination is not exercised in this environment.
+    ``test -f DIR`` and resume work identically.  Multi-process
+    coordination (every host writing shards into the SAME directory, with
+    the barrier'd atomic swap below) is exercised for real — 2 processes
+    x 2 devices with model-parallel sharding, including kill-and-resume
+    and cross-topology restores — in tests/test_ckpt_topology.py; the
+    path must live on a filesystem all hosts share (warned at save time).
 """
 
 from __future__ import annotations
@@ -138,11 +142,28 @@ def require_orbax() -> None:
             "(pip install orbax-checkpoint)") from e
 
 
+_warned_shared_fs = False
+
+
 def _save_orbax(path: str, model_name: str, state: TrainState,
                 epoch: int, best_valid_loss: float) -> None:
     import orbax.checkpoint as ocp
 
     from . import runtime
+
+    global _warned_shared_fs
+    if jax.process_count() > 1 and not _warned_shared_fs:
+        # The .tmp cleanup, meta write and atomic swap below run on
+        # process 0 only — every host MUST see the same filesystem at
+        # ``path`` (true on the shared storage multi-host TPU setups
+        # mount; NOT true for per-host local disks, where the other
+        # hosts' shards would be stranded under .tmp).  Exercised for
+        # real in tests/test_ckpt_topology.py.
+        logging.warning(
+            f"orbax checkpoint {path!r} is written by {jax.process_count()}"
+            " processes: the path must be on a filesystem shared by all"
+            " hosts (per-host local disks will strand non-main shards)")
+        _warned_shared_fs = True
 
     path = os.path.abspath(path)
     tmp = path + ".tmp"
@@ -171,6 +192,11 @@ def _save_orbax(path: str, model_name: str, state: TrainState,
 
 def _load_orbax(path: str, state: TrainState, restore_optimizer: bool
                 ) -> Tuple[TrainState, int, float]:
+    # Loading auto-detects orbax by directory-ness, without --ckpt-format
+    # orbax ever being passed — so the availability check must happen
+    # here, surfacing the CLI-catchable ValueError rather than a raw
+    # ImportError traceback.
+    require_orbax()
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
@@ -186,15 +212,53 @@ def _load_orbax(path: str, state: TrainState, restore_optimizer: bool
                          f"{meta.get('format_version')!r}")
     # Shapes/dtypes only — no device_get: the template may hold sharded
     # (multi-host: non-addressable) arrays, and copying params+opt_state
-    # to host just to read .shape would be waste anyway.
+    # to host just to read .shape would be waste anyway.  Restore target
+    # shardings, per leaf: a template already PLACED on a global mesh
+    # restores as-laid-out (a --model-parallel state never transiently
+    # replicates — the drivers place the template before loading a
+    # directory checkpoint for exactly this reason); anything else
+    # restores replicated over every device, which is what makes a
+    # checkpoint saved on one process topology resumable on another
+    # (orbax requires a concrete global sharding per leaf whenever
+    # process_count > 1; tests/test_ckpt_topology.py).
+    from jax.sharding import Mesh
+
     template = serialization.to_state_dict(state)
+    n_devices = len(jax.devices())
+    replicated = NamedSharding(
+        Mesh(np.asarray(jax.devices()).reshape(-1), ("_all",)),
+        PartitionSpec())
+
+    def leaf_target(x):
+        s = getattr(x, "sharding", None)
+        if isinstance(s, NamedSharding) and len(s.device_set) == n_devices:
+            return s  # placed on the global mesh: restore as-laid-out
+        return replicated
+
     abstract = jax.tree_util.tree_map(
         lambda x: jax.ShapeDtypeStruct(
-            tuple(np.shape(x)), getattr(x, "dtype", np.asarray(x).dtype)),
+            tuple(np.shape(x)), getattr(x, "dtype", np.asarray(x).dtype),
+            sharding=leaf_target(x)),
         template)
     try:
-        restored_dict = ocp.StandardCheckpointer().restore(
-            os.path.join(path, "state"), abstract)
+        if restore_optimizer:
+            restored_dict = ocp.StandardCheckpointer().restore(
+                os.path.join(path, "state"), abstract)
+        else:
+            # test / resume-under-a-different-optimizer: the saved
+            # opt_state may not structurally match the current
+            # optimizer's — and its bytes are not wanted either way, so
+            # it is excluded from the restore entirely (partial restore:
+            # no disk read, no transient device copies); the fresh
+            # template opt_state is grafted back below.  The msgpack
+            # path gets the same semantics by overwriting before
+            # from_state_dict.
+            abstract.pop("opt_state", None)
+            with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ptc:
+                restored_dict = ptc.restore(
+                    os.path.join(path, "state"),
+                    args=ocp.args.PyTreeRestore(item=abstract,
+                                                partial_restore=True))
     except Exception as e:
         raise ValueError(f"cannot restore orbax checkpoint {path!r}: "
                          f"{e}") from e
@@ -252,6 +316,7 @@ def load_checkpoint(path: str, state: TrainState,
 def get_checkpoint_model_name(path: str) -> str:
     """ref getCheckpointModelName (utils.py:138-140); both formats."""
     if os.path.isdir(path):
+        require_orbax()  # the load that follows sniffing will need it
         meta_path = os.path.join(path, _ORBAX_META)
         try:
             with open(meta_path) as f:

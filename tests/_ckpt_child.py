@@ -1,0 +1,78 @@
+"""Child process for checkpoint topology/format tests
+(tests/test_ckpt_topology.py).
+
+One simulated host: provisions local virtual CPU devices, optionally joins
+a gloo rendezvous, runs ``run_train`` with the requested checkpoint format
+/ model-parallelism / resume file, and dumps its local copy of the final
+(gathered) parameters plus the run history.
+
+Unlike _mp_child.py, the ``--rsl`` directory is SHARED between processes:
+orbax multi-host checkpointing writes every host's shards into the same
+checkpoint directory (checkpoint.py _save_orbax barriers), which is the
+behavior under test.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coord", default=None)
+    ap.add_argument("--nproc", type=int, default=1)
+    ap.add_argument("--pid", type=int, default=0)
+    ap.add_argument("--devices-per-proc", type=int, default=2)
+    ap.add_argument("--rsl", required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--ckpt-format", default="msgpack")
+    ap.add_argument("--model-parallel", type=int, default=1)
+    ap.add_argument("--resume-from", default=None)
+    a = ap.parse_args()
+
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={a.devices_per_proc}")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+    from distributedpytorch_tpu import runtime
+
+    if a.nproc > 1:
+        runtime.initialize_distributed(coordinator_address=a.coord,
+                                       num_processes=a.nproc,
+                                       process_id=a.pid)
+        assert jax.process_count() == a.nproc
+
+    import numpy as np
+
+    from distributedpytorch_tpu import checkpoint as ckpt
+    from distributedpytorch_tpu.cli import run_train
+    from distributedpytorch_tpu.config import Config
+
+    cfg = Config(action="train", data_path="/tmp/nodata", rsl_path=a.rsl,
+                 dataset="synthetic", model_name="mlp", batch_size=4,
+                 nb_epochs=a.epochs, debug=True, half_precision=False,
+                 ckpt_format=a.ckpt_format,
+                 model_parallel=a.model_parallel,
+                 checkpoint_file=a.resume_from)
+    result = run_train(cfg)
+
+    gathered = ckpt.gather_replicated(result["state"])
+    out = {}
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(gathered.params)):
+        out[f"p{i}"] = (np.asarray(leaf.addressable_shards[0].data)
+                        if hasattr(leaf, "addressable_shards")
+                        else np.asarray(leaf))
+    np.savez(a.out, **out)
+    with open(a.out + ".history.json", "w") as f:
+        json.dump({"history": result["history"],
+                   "preempted": result["preempted"]}, f)
+    print(f"rank {a.pid} done", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,193 @@
+"""Checkpoints under real multi-process concurrency and across process
+topologies / formats (VERDICT round-2 items #5 and #6).
+
+World launches (each a fresh set of python subprocesses over gloo):
+
+  A  2 procs x 2 devs | orbax, model-parallel=2, 2 epochs   (baseline)
+  B  2 procs x 2 devs | orbax, mp=2, 1 epoch
+  C  2 procs x 2 devs | orbax, mp=2, resume B -> epoch 2
+  D  1 proc  x 4 devs | msgpack, 1 epoch
+  E  2 procs x 2 devs | resume D's msgpack, save orbax -> epoch 2
+  F  1 proc  x 4 devs | orbax, 1 epoch
+  G  2 procs x 2 devs | resume F's orbax, save msgpack -> epoch 2
+  H  1 proc  x 4 devs | msgpack, 2 epochs                   (mp=1 baseline)
+  P  2 procs x 2 devs | orbax, mp=2, SIGTERM to ONE process mid-run,
+     then a resume world from the checkpoint the preempted run wrote
+
+Asserted:
+  * C == A: the multi-process orbax save (every host writing shards into
+    the SAME directory through the checkpoint.py barriers) round-trips
+    training state exactly — the "validated single-host only" caveat is
+    retired by this test;
+  * E == H and G == H: checkpoints written on a 1x4 world restore on a
+    2x2 world (and vice versa formats msgpack<->orbax both directions) —
+    the "loads anywhere" contract (checkpoint.py docstring) across
+    topologies, not just same-topology;
+  * orbax rotation under concurrency: only the newest rolling directory
+    remains, bestmodel dir valid (meta.json present);
+  * P: a SIGTERM to one of two hosts yields clean exits (rc 0) on both, a
+    complete orbax checkpoint from the agreed epoch boundary, and a
+    successful multi-process resume continuing at the next epoch.
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tests._subproc import (REPO, await_all, free_port, launch_logged,
+                            wait_for_epoch_line)
+
+CHILD = os.path.join(REPO, "tests", "_ckpt_child.py")
+
+
+def _launch_world(tmp, name, nproc, devices, *, epochs, fmt, mp=1,
+                  resume=None):
+    """Launch one world (nproc processes) and wait for clean exits."""
+    rsl = os.path.join(tmp, name)
+    port = free_port()
+    procs, logs = [], []
+    for r in range(nproc):
+        cmd = [sys.executable, CHILD, "--nproc", str(nproc),
+               "--pid", str(r), "--devices-per-proc", str(devices),
+               "--rsl", rsl, "--out", _out(tmp, name, r),
+               "--epochs", str(epochs), "--ckpt-format", fmt,
+               "--model-parallel", str(mp)]
+        if nproc > 1:
+            cmd += ["--coord", f"localhost:{port}"]
+        if resume:
+            cmd += ["--resume-from", resume]
+        log = os.path.join(tmp, f"{name}_r{r}.log")
+        logs.append(log)
+        procs.append(launch_logged(cmd, log))
+    await_all(procs, logs)
+    return rsl
+
+
+def _out(tmp, name, rank):
+    return os.path.join(tmp, f"{name}_out{rank}.npz")
+
+
+def _params(tmp, name, rank=0):
+    return dict(np.load(_out(tmp, name, rank)))
+
+
+def _ckpt(rsl, epoch):
+    return os.path.join(rsl, f"checkpoint-synthetic-mlp-{epoch:03d}.ckpt")
+
+
+@pytest.fixture(scope="module")
+def runs(tmp_path_factory):
+    tmp = str(tmp_path_factory.mktemp("ckpt_topo"))
+
+    rsl_a = _launch_world(tmp, "A", 2, 2, epochs=2, fmt="orbax", mp=2)
+    rsl_b = _launch_world(tmp, "B", 2, 2, epochs=1, fmt="orbax", mp=2)
+    _launch_world(tmp, "C", 2, 2, epochs=2, fmt="orbax", mp=2,
+                  resume=_ckpt(rsl_b, 0))
+    rsl_d = _launch_world(tmp, "D", 1, 4, epochs=1, fmt="msgpack")
+    _launch_world(tmp, "E", 2, 2, epochs=2, fmt="orbax",
+                  resume=_ckpt(rsl_d, 0))
+    rsl_f = _launch_world(tmp, "F", 1, 4, epochs=1, fmt="orbax")
+    _launch_world(tmp, "G", 2, 2, epochs=2, fmt="msgpack",
+                  resume=_ckpt(rsl_f, 0))
+    _launch_world(tmp, "H", 1, 4, epochs=2, fmt="msgpack")
+    return tmp, rsl_a
+
+
+def test_multiprocess_orbax_resume_matches_continuous(runs):
+    tmp, _ = runs
+    a, c = _params(tmp, "A"), _params(tmp, "C")
+    assert set(a) == set(c) and len(a) > 0
+    for k in a:
+        np.testing.assert_allclose(c[k], a[k], rtol=1e-6, atol=1e-7,
+                                   err_msg=f"{k}: resumed != continuous")
+
+
+def test_multiprocess_ranks_agree(runs):
+    tmp, _ = runs
+    for name in ("A", "C", "E", "G"):
+        r0, r1 = _params(tmp, name, 0), _params(tmp, name, 1)
+        for k in r0:
+            np.testing.assert_array_equal(
+                r0[k], r1[k], err_msg=f"{name}/{k} differs across ranks")
+
+
+def test_cross_topology_msgpack_to_orbax(runs):
+    tmp, _ = runs
+    e, h = _params(tmp, "E"), _params(tmp, "H")
+    for k in e:
+        np.testing.assert_allclose(
+            e[k], h[k], rtol=2e-5, atol=2e-6,
+            err_msg=f"{k}: 1x4-saved msgpack resumed on 2x2 != continuous")
+
+
+def test_cross_topology_orbax_to_msgpack(runs):
+    tmp, _ = runs
+    g, h = _params(tmp, "G"), _params(tmp, "H")
+    for k in g:
+        np.testing.assert_allclose(
+            g[k], h[k], rtol=2e-5, atol=2e-6,
+            err_msg=f"{k}: 1x4-saved orbax resumed on 2x2 != continuous")
+
+
+def test_orbax_rotation_and_layout_under_concurrency(runs):
+    _, rsl_a = runs
+    entries = sorted(os.listdir(rsl_a))
+    rolling = [e for e in entries if e.startswith("checkpoint-")]
+    # rotation deleted epoch 000; the epoch-001 directory remains
+    assert rolling == ["checkpoint-synthetic-mlp-001.ckpt"], entries
+    best = os.path.join(rsl_a, "bestmodel-synthetic-mlp.ckpt")
+    assert os.path.isdir(best)
+    with open(os.path.join(best, "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["model_name"] == "mlp"
+    # no stale .tmp staging dirs left behind by the barrier'd swap
+    assert not [e for e in entries if e.endswith(".tmp")], entries
+
+
+def test_sigterm_one_host_then_multiprocess_resume(tmp_path):
+    """Kill-and-resume under orbax + model-parallel: SIGTERM ONE host of
+    two; both must exit 0 after writing the agreed-epoch checkpoint; a
+    fresh 2-process world resumes it for one more epoch."""
+    tmp = str(tmp_path)
+    rsl = os.path.join(tmp, "P")
+    port = free_port()
+    logs = [os.path.join(tmp, f"P_r{r}.log") for r in range(2)]
+    procs = [launch_logged(
+        [sys.executable, CHILD, "--coord", f"localhost:{port}",
+         "--nproc", "2", "--pid", str(r), "--devices-per-proc", "2",
+         "--rsl", rsl, "--out", _out(tmp, "P", r),
+         "--epochs", "100", "--ckpt-format", "orbax",
+         "--model-parallel", "2"],
+        logs[r]) for r in range(2)]
+    try:
+        wait_for_epoch_line(os.path.join(rsl, "test.log"), procs,
+                            proc_logs=logs)
+        procs[1].send_signal(signal.SIGTERM)
+        for p in procs:
+            p.wait(timeout=600)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, p in enumerate(procs):
+        assert p.returncode == 0, \
+            f"rank {r}:\n{open(logs[r]).read()[-3000:]}"
+    hist = json.load(open(_out(tmp, "P", 0) + ".history.json"))
+    assert hist["preempted"]
+    stopped = hist["history"][-1]["epoch"]
+
+    rolling = [e for e in os.listdir(rsl) if e.startswith("checkpoint-")]
+    assert rolling == [f"checkpoint-synthetic-mlp-{stopped:03d}.ckpt"], \
+        rolling
+
+    # resume the preempted checkpoint on a fresh 2-process world
+    _launch_world(tmp, "PR", 2, 2, epochs=stopped + 2, fmt="orbax", mp=2,
+                  resume=os.path.join(rsl, rolling[0]))
+    hist2 = json.load(open(_out(tmp, "PR", 0) + ".history.json"))
+    resumed_epochs = [h["epoch"] for h in hist2["history"]]
+    assert resumed_epochs and resumed_epochs[0] == stopped + 1, hist2

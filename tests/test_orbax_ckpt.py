@@ -111,3 +111,35 @@ def test_bad_ckpt_format_rejected(tmp_path):
                  ckpt_format="Orbax")
     with pytest.raises(ValueError, match="ckpt_format"):
         run_train(cfg)
+
+
+def test_orbax_restore_without_optimizer_across_optimizers(tmp_path):
+    """ADVICE r2: restore_optimizer=False must work even when the saved
+    opt_state (adam: two moment trees) does not structurally match the
+    current optimizer's (SGD+momentum: one trace tree) — the abstract
+    restore template takes opt_state from the DISK metadata and discards
+    it, grafting the fresh template opt_state back."""
+    model = get_model("mlp", 10, half_precision=False)
+    tx_adam = make_optimizer("adam", 1e-3, 0.9, 0.1, 4, False)
+    eng_adam = Engine(model, "mlp", get_loss_fn("cross_entropy"), tx_adam,
+                      mean=0.45, std=0.2, input_size=28,
+                      half_precision=False)
+    state = eng_adam.init_state(jax.random.PRNGKey(0), 1)
+    path = str(tmp_path / "ck_adam")
+    ckpt.save_checkpoint(path, "mlp", state, 2, 0.5, fmt="orbax")
+
+    tx_sgd = make_optimizer("SGD", 1e-3, 0.9, 0.1, 4, False)
+    eng_sgd = Engine(model, "mlp", get_loss_fn("cross_entropy"), tx_sgd,
+                     mean=0.45, std=0.2, input_size=28,
+                     half_precision=False)
+    template = eng_sgd.init_state(jax.random.PRNGKey(1), 1)
+    restored, next_epoch, best = ckpt.load_checkpoint(
+        path, template, restore_optimizer=False)
+    assert next_epoch == 3 and best == 0.5
+    # params came from the checkpoint; opt_state stayed the SGD template's
+    for a, b in zip(
+            jax.tree_util.tree_leaves(jax.device_get(state.params)),
+            jax.tree_util.tree_leaves(jax.device_get(restored.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert (jax.tree_util.tree_structure(restored.opt_state)
+            == jax.tree_util.tree_structure(template.opt_state))
