@@ -99,7 +99,8 @@ def load_cifar10(root: str
 
 def make_synthetic(num_train: int = 60000, num_test: int = 10000,
                    image_size: int = 28, channels: int = 1,
-                   num_classes: int = 10, seed: int = 0
+                   num_classes: int = 10, seed: int = 0,
+                   class_sep: float = 1.0, noise: float = 32.0
                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Deterministic learnable MNIST-shaped corpus.
 
@@ -107,6 +108,15 @@ def make_synthetic(num_train: int = 60000, num_test: int = 10000,
     prototype plus noise and a random brightness jitter, so a small CNN can
     fit it quickly — giving tests/benchmarks a real learning signal without
     shipping the actual MNIST files.
+
+    ``class_sep`` < 1 shrinks every prototype toward the all-class mean
+    (raising inter-class overlap) and ``noise`` raises the per-pixel
+    sigma — together they make the Bayes error nonzero, which is what the
+    accuracy-parity harness needs: at the defaults a 2-epoch CNN saturates
+    at 100% and equal-at-ceiling accuracies carry no information (round-2
+    verdict); SYNTH_HARD below is tuned so the same CNN lands mid-range,
+    where a real learning-dynamics divergence between the two frameworks
+    would show up as an accuracy gap.
     """
     rng = np.random.default_rng(seed)
     # Smooth per-class prototypes: low-frequency random fields, upsampled.
@@ -114,13 +124,16 @@ def make_synthetic(num_train: int = 60000, num_test: int = 10000,
     protos = low.repeat(image_size // 7 + 1, axis=1)[:, :image_size]
     protos = protos.repeat(image_size // 7 + 1, axis=2)[:, :, :image_size]
     protos = (protos - protos.min()) / (np.ptp(protos) + 1e-8)
+    if class_sep != 1.0:
+        mean_proto = protos.mean(axis=0, keepdims=True)
+        protos = mean_proto + class_sep * (protos - mean_proto)
 
     def _split(n, split_seed):
         r = np.random.default_rng(split_seed)
         y = r.integers(0, num_classes, size=n).astype(np.int32)
         x = protos[y] * 255.0
         x = x * r.uniform(0.6, 1.0, size=(n, 1, 1, 1))
-        x = x + r.normal(0, 32.0, size=x.shape)
+        x = x + r.normal(0, noise, size=x.shape)
         x = np.clip(x, 0, 255).astype(np.uint8)
         if channels == 1:
             x = x[..., 0]
@@ -129,6 +142,12 @@ def make_synthetic(num_train: int = 60000, num_test: int = 10000,
     tr_x, tr_y = _split(num_train, seed + 1)
     te_x, te_y = _split(num_test, seed + 2)
     return tr_x, tr_y, te_x, te_y
+
+
+# The non-saturating variant the accuracy-parity harness trains on
+# (--dataset synthetic_hard): tuned so the reference recipe (2-epoch CNN,
+# batch 64, Adam 1e-3) lands mid-range instead of at the 100% ceiling.
+SYNTH_HARD = {"class_sep": 0.45, "noise": 70.0}
 
 
 def load_raw(dataset: str, data_path: str, synthetic_fallback: bool = False):
@@ -159,4 +178,6 @@ def load_raw(dataset: str, data_path: str, synthetic_fallback: bool = False):
         dataset = "synthetic"
     if dataset == "synthetic":
         return make_synthetic()
+    if dataset == "synthetic_hard":
+        return make_synthetic(**SYNTH_HARD)
     raise ValueError(f"unknown dataset {dataset!r}")

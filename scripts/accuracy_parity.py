@@ -48,7 +48,7 @@ def load_corpus(dataset: str, data_path: str, seed: int):
     from distributedpytorch_tpu.data.datasets import load_dataset
 
     ds = load_dataset(dataset, data_path, seed,
-                      synthetic_fallback=(dataset == "synthetic"))
+                      synthetic_fallback=dataset.startswith("synthetic"))
     return ds
 
 
@@ -160,12 +160,15 @@ def run_reference(ds, epochs: int, batch: int, seed: int,
     valid_acc_at_best = 0.0
     best_state = copy.deepcopy(model.state_dict())
     tr_acc = float("nan")
+    valid_loss_curve, valid_acc_curve = [], []
     t0 = time.monotonic()
     for epoch in range(epochs):
         tr_loss, tr_acc = run_epoch(tr, True, n_train)
         va_loss, va_acc = run_epoch(ds.splits["valid"], False)
         log(f"[ref] epoch {epoch}: train loss {tr_loss:.4f} "
             f"acc {tr_acc:.4f} | valid loss {va_loss:.4f} acc {va_acc:.4f}")
+        valid_loss_curve.append(round(va_loss, 5))
+        valid_acc_curve.append(round(va_acc, 5))
         if va_loss < best_valid:
             best_valid, valid_acc_at_best = va_loss, va_acc
             # snapshot like the reference's bestmodel checkpoint
@@ -176,7 +179,10 @@ def run_reference(ds, epochs: int, batch: int, seed: int,
     te_loss, te_acc = run_epoch(ds.splits["test"], False)
     log(f"[ref] test acc {te_acc:.4f} ({time.monotonic() - t0:.0f}s)")
     return {"valid_acc": valid_acc_at_best, "test_acc": te_acc,
-            "train_acc_final": tr_acc, "seconds": time.monotonic() - t0}
+            "train_acc_final": tr_acc,
+            "valid_loss_curve": valid_loss_curve,
+            "valid_acc_curve": valid_acc_curve,
+            "seconds": time.monotonic() - t0}
 
 
 # ------------------------------------------------------------------- ours --
@@ -195,13 +201,14 @@ def run_ours(dataset: str, data_path: str, epochs: int, batch: int,
     cfg = Config(action="train", data_path=data_path, rsl_path=rsl,
                  dataset=dataset, model_name="cnn", batch_size=batch,
                  nb_epochs=epochs, seed=seed,
-                 synthetic_fallback=(dataset == "synthetic"))
+                 synthetic_fallback=dataset.startswith("synthetic"))
     result = run_train(cfg)
     best = ckpt.best_model_path(rsl, dataset, "cnn")
     test = run_test(Config(action="test", data_path=data_path, rsl_path=rsl,
                            dataset=dataset, batch_size=batch, seed=seed,
                            checkpoint_file=best,
-                           synthetic_fallback=(dataset == "synthetic")))
+                           synthetic_fallback=dataset.startswith(
+                               "synthetic")))
     hist = result["history"]
     best_epoch = min(hist, key=lambda h: h["valid_loss"])
     log(f"[ours] valid acc {best_epoch['valid_acc']:.4f}, "
@@ -209,14 +216,18 @@ def run_ours(dataset: str, data_path: str, epochs: int, batch: int,
     return {"valid_acc": best_epoch["valid_acc"],
             "test_acc": test["test_acc"],
             "train_acc_final": hist[-1]["train_acc"],
+            "valid_loss_curve": [round(h["valid_loss"], 5) for h in hist],
+            "valid_acc_curve": [round(h["valid_acc"], 5) for h in hist],
             "seconds": time.monotonic() - t0}
 
 
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--dataset", default=None,
-                   help="mnist|fashion_mnist|synthetic (default: mnist if "
-                        "raw files exist under --data-path, else synthetic)")
+                   help="mnist|fashion_mnist|synthetic|synthetic_hard "
+                        "(default: mnist if raw files exist under "
+                        "--data-path, else synthetic_hard — the "
+                        "non-saturating corpus, io.py SYNTH_HARD)")
     p.add_argument("--data-path", default="./data")
     p.add_argument("--epochs", type=int, default=2)  # ref config.py:38
     p.add_argument("--batch", type=int, default=64)  # ref config.py:40
@@ -238,9 +249,9 @@ def main() -> int:
             io.load_mnist_like(args.data_path, "MNIST")
             dataset = "mnist"
         except FileNotFoundError:
-            log("no real MNIST under --data-path; using the synthetic "
+            log("no real MNIST under --data-path; using the hard synthetic "
                 "corpus (fetch real files with scripts/fetch_mnist.sh)")
-            dataset = "synthetic"
+            dataset = "synthetic_hard"
 
     ds = load_corpus(dataset, args.data_path, args.seed)
     ours = (None if args.skip_ours else
