@@ -360,6 +360,55 @@ def run_suite(args) -> dict:
     return rows
 
 
+def run_attention_suite(args) -> dict:
+    """Long-context attention: the Pallas flash kernel
+    (ops/flash_attention.py) vs XLA's fused softmax attention, fwd+bwd,
+    causal, bf16 — repetitions fused into ONE lax.scan dispatch (the same
+    methodology as the headline bench; per-call host timing is unreliable
+    over the tunneled chip)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributedpytorch_tpu.ops import attention
+    from distributedpytorch_tpu.ops.flash_attention import flash_attention
+
+    def measure(fn, shape, n=30):
+        ks = jax.random.split(jax.random.PRNGKey(1), 3)
+        q, k, v = (jax.random.normal(kk, shape, jnp.bfloat16) for kk in ks)
+        grad = jax.grad(
+            lambda a, b, c: jnp.sum(fn(a, b, c).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2))
+
+        def body(carry, _):
+            dq, _dk, _dv = grad(carry, k, v)
+            return carry + 1e-6 * dq.astype(carry.dtype), None
+
+        run = jax.jit(lambda q0: jax.lax.scan(body, q0, None, length=n)[0])
+        jax.block_until_ready(run(q))
+        t0 = time.monotonic()
+        jax.block_until_ready(run(q))
+        return (time.monotonic() - t0) / n
+
+    rows = {}
+    for b, s in ((4, 2048), (4, 4096), (2, 8192)):
+        shape = (b, s, 8, 64)
+        t_flash = measure(lambda a, x, y: flash_attention(a, x, y,
+                                                          causal=True),
+                          shape)
+        t_xla = measure(lambda a, x, y: attention.full_attention(
+            a, x, y, causal=True), shape)
+        rows[f"b{b}_s{s}"] = {
+            "shape_BSHD": list(shape), "causal": True, "dtype": "bfloat16",
+            "pallas_flash_ms": round(t_flash * 1e3, 2),
+            "xla_full_ms": round(t_xla * 1e3, 2),
+            "speedup": round(t_xla / t_flash, 2),
+        }
+        log(f"attention b{b} s{s}: flash {t_flash * 1e3:.2f} ms vs "
+            f"xla {t_xla * 1e3:.2f} ms (fwd+bwd) -> "
+            f"{t_xla / t_flash:.2f}x")
+    return rows
+
+
 def run_scaling(args) -> dict:
     """Scaling-MECHANISM measurement on the virtual CPU mesh: the same
     global batch (64) run unsharded on 1 device vs sharded over 8, same
@@ -432,6 +481,15 @@ def main() -> int:
     extra = {}
     if args.suite:
         extra["suite"] = run_suite(args)
+        import jax
+
+        if jax.default_backend() == "tpu":
+            extra["attention"] = run_attention_suite(args)
+        else:
+            # off-TPU the Pallas kernels run in interpret mode — emulated
+            # S=8192 attention would take hours; the rows are TPU-only
+            log("skipping attention suite (no TPU backend; the Pallas "
+                "kernels would run in interpret mode)")
     if args.scaling:
         extra["scaling"] = run_scaling(args)
     if extra:

@@ -342,16 +342,19 @@ def run_train(cfg: Config) -> dict:
         raise ValueError(
             f"--grad-accum must be >= 1 and divide the per-replica batch "
             f"size ({cfg.batch_size}); got {cfg.grad_accum}")
-    if (cfg.attention == "ring" or cfg.tensor_parallel) \
-            and (model_name != "vit" or cfg.model_parallel < 2
-                 or (cfg.attention == "ring" and cfg.tensor_parallel)):
+    if (cfg.attention != "full" or cfg.tensor_parallel) \
+            and (model_name != "vit"
+                 or (cfg.attention != "full" and cfg.tensor_parallel)
+                 or (cfg.attention == "ring" and cfg.model_parallel < 2)
+                 or (cfg.tensor_parallel and cfg.model_parallel < 2)):
         # the registry enforces this too; checking here fails the run
         # before the dataset load pays for a doomed configuration
         raise ValueError(
-            "--attention ring / --tensor-parallel require --model vit "
-            "and --model-parallel >= 2 (both ride the 'model' mesh "
-            "axis) and are mutually exclusive; got "
-            f"model={model_name!r}, model_parallel={cfg.model_parallel}, "
+            "--attention ring/flash and --tensor-parallel require "
+            "--model vit; ring and tensor-parallel additionally need "
+            "--model-parallel >= 2 and compose only with --attention "
+            f"full; got model={model_name!r}, "
+            f"model_parallel={cfg.model_parallel}, "
             f"attention={cfg.attention!r}, "
             f"tensor_parallel={cfg.tensor_parallel}")
     _validate_ckpt_format(cfg)

@@ -108,9 +108,11 @@ class Config:
     # param/optimizer tensors over the 'model' axis (ZeRO/FSDP-style,
     # see parallel.py).  1 = pure data parallelism (reference semantics).
     model_parallel: int = 1
-    # 'full': fused softmax attention on each device (default);
+    # 'full': XLA softmax attention on each device (default);
     # 'ring': sequence-parallel ring attention over the 'model' mesh axis
-    # (vit only, needs model_parallel >= 2 — see ops/attention.py).
+    # (vit only, needs model_parallel >= 2 — see ops/attention.py);
+    # 'flash': the Pallas flash-attention TPU kernel, O(S) memory
+    # (vit only — see ops/flash_attention.py).
     attention: str = "full"
     # Megatron-style tensor parallelism for vit: attention heads + MLP
     # hidden sharded over 'model' with SHARDED ACTIVATIONS (parallel.py
@@ -187,12 +189,13 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                    help="shard large param/optimizer tensors over an "
                         "N-way 'model' mesh axis (must divide the device "
                         "count; default 1 = replicated)")
-    p.add_argument("--attention", choices=("full", "ring"),
+    p.add_argument("--attention", choices=("full", "ring", "flash"),
                    default="full",
-                   help="attention implementation for --model vit: fused "
-                        "softmax (default) or sequence-parallel ring "
+                   help="attention implementation for --model vit: XLA "
+                        "softmax (default), sequence-parallel ring "
                         "attention over the 'model' mesh axis (requires "
-                        "--model-parallel >= 2)")
+                        "--model-parallel >= 2), or the Pallas "
+                        "flash-attention kernel (O(S) memory)")
     p.add_argument("--tensor-parallel", action="store_true",
                    dest="tensorParallel",
                    help="Megatron-style tensor parallelism for --model "

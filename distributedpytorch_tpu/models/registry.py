@@ -72,34 +72,46 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
     if name not in MODEL_REGISTRY:
         raise ValueError(f"Invalid model name {name!r} "
                          f"(choices: {sorted(MODEL_REGISTRY)})")
-    if attention not in ("full", "ring"):
-        raise ValueError(f"attention must be 'full' or 'ring', "
+    if attention not in ("full", "ring", "flash"):
+        raise ValueError(f"attention must be 'full', 'ring' or 'flash', "
                          f"got {attention!r}")
     dtype = jnp.bfloat16 if half_precision else jnp.float32
-    if attention == "ring" or tensor_parallel:
+    if attention != "full" or tensor_parallel:
         if name != "vit":
-            feature = ("--attention ring" if attention == "ring"
+            feature = (f"--attention {attention}" if attention != "full"
                        else "--tensor-parallel")
             raise ValueError(
                 f"{feature} applies to the attention model family "
                 f"only (--model vit); {name!r} has no attention")
-        if attention == "ring" and tensor_parallel:
+        if attention != "full" and tensor_parallel:
             raise ValueError(
-                "--attention ring and --tensor-parallel both shard over "
-                "the 'model' axis (sequence vs heads) — pick one")
+                "--tensor-parallel composes only with --attention full "
+                "(ring shards the same 'model' axis; the flash Pallas "
+                "kernel is not GSPMD-partitionable over heads) — pick one")
         from .vit import ViT
 
+        attn_fn = None
         if attention == "ring":
             from ..ops.attention import make_ring_attention
 
             _require_model_axis(mesh, "--attention ring (token axis)")
-            return ViT(num_classes=num_classes, dtype=dtype,
-                       attention_fn=make_ring_attention(mesh))
-        from ..parallel import make_tp_constrain
+            attn_fn = make_ring_attention(mesh)
+        elif attention == "flash":
+            # the Pallas flash kernel (ops/flash_attention.py): O(S)
+            # memory, single-device; no mesh requirement
+            from ..ops.flash_attention import flash_attention
 
-        _require_model_axis(mesh, "--tensor-parallel (head/hidden axes)")
+            attn_fn = flash_attention
+        if tensor_parallel:
+            from ..parallel import make_tp_constrain
+
+            _require_model_axis(mesh, "--tensor-parallel (head/hidden "
+                                      "axes)")
+            return ViT(num_classes=num_classes, dtype=dtype,
+                       attention_fn=attn_fn,
+                       tp_constrain=make_tp_constrain(mesh))
         return ViT(num_classes=num_classes, dtype=dtype,
-                   tp_constrain=make_tp_constrain(mesh))
+                   attention_fn=attn_fn)
     return MODEL_REGISTRY[name](num_classes, dtype)
 
 

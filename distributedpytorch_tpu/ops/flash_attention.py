@@ -1,0 +1,300 @@
+"""Flash attention as a Pallas TPU kernel — the fused, O(S) -memory
+attention for the framework's attention model family.
+
+The reference has no attention at all (SURVEY §2: image CNNs only); this
+is TPU-first framework capability, written against the Pallas TPU
+programming model (/opt/skills/guides/pallas_guide.md):
+
+  * the S x S score matrix NEVER exists in HBM: each (batch*head,
+    q-block) program streams K/V blocks through VMEM, carrying the
+    flash running-max/denominator in registers (jax.lax.fori_loop);
+  * Q/K/V blocks are (128, D) tiles, so the q @ k^T and p @ v
+    contractions land on the 128x128 MXU at full tile width;
+  * the backward pass is the standard two-kernel flash scheme (one
+    program per q-block for dq, one per k-block for dk/dv), recomputing
+    p from the saved log-sum-exp instead of storing probabilities;
+  * causal masking and ragged lengths (kv_valid) are fused into the
+    same kernels, so any sequence length works: callers zero-pad S up
+    to a block multiple and the padded key columns are masked out
+    (padded query rows produce zeros and are sliced off).
+
+Numerics are pinned against ops.attention.full_attention — outputs AND
+gradients, causal and ragged included — in tests/test_flash_attention.py
+(Pallas interpret mode, so the same kernels are exercised on the CPU
+mesh), and again on the real chip by bench.py's attention suite.
+
+Scope bound: K and V for one (batch, head) must fit in VMEM in the INPUT
+dtype (~16 MB/core => 2 * S * D * itemsize within a few MB): bf16 — the
+product path — reaches S=16384 at D=128 in 8 MB, f32 half that.  That
+covers the long-context regime this model family targets on ONE chip;
+beyond it, ops.attention.ring_attention shards S across chips and can
+use this kernel per-shard.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG = -1e30  # finite masked-score sentinel (keeps exp/sub NaN-free)
+BLOCK = 128   # q/k block rows: one MXU tile of lanes
+
+
+def _use_interpret() -> bool:
+    # Real Mosaic lowering on TPU; interpreter everywhere else (CPU mesh
+    # tests run the SAME kernel logic).
+    return jax.default_backend() != "tpu"
+
+
+def _masks(iq, kb, bq, bk, causal, kv_valid):
+    """(bq, bk) boolean mask of VALID score entries, or None."""
+    need = causal or kv_valid is not None
+    if not need:
+        return None
+    rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = None
+    if causal:
+        mask = rows >= cols
+    if kv_valid is not None:
+        kvm = cols < kv_valid
+        mask = kvm if mask is None else mask & kvm
+    return mask
+
+
+# ---------------------------------------------------------------- forward --
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                causal: bool, kv_valid, scale: float):
+    bq = q_ref.shape[1]
+    d = q_ref.shape[2]
+    s = k_ref.shape[1]
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale            # (bq, d)
+
+    n_kb = s // block_k
+    if causal:
+        # blocks strictly above the diagonal contribute nothing
+        n_kb = jnp.minimum(n_kb, ((iq + 1) * bq + block_k - 1) // block_k)
+
+    def body(kb, carry):
+        acc, m, l = carry
+        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        sc = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        mask = _masks(iq, kb, bq, block_k, causal, kv_valid)
+        if mask is not None:
+            sc = jnp.where(mask, sc, _NEG)
+        m_blk = jnp.max(sc, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(sc - m_new)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc, m, l = jax.lax.fori_loop(
+        0, n_kb, body,
+        (jnp.zeros((bq, d), jnp.float32),
+         jnp.full((bq, 1), _NEG, jnp.float32),
+         jnp.zeros((bq, 1), jnp.float32)))
+    l_safe = jnp.maximum(l, 1e-30)                      # padded rows: l == 0
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    # lse is stored (bq, 8): Mosaic block shapes need the last dim either
+    # 128-divisible or equal to the array's — a (bq,) vector is neither,
+    # so the scalar-per-row is broadcast across 8 lanes (sublane tile).
+    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l_safe), (bq, 8))
+
+
+def _flash_fwd(q, k, v, causal: bool, kv_valid, block: int):
+    bh, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    grid = (bh, s // block)
+    kv_spec = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0))
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_k=block, causal=causal,
+                          kv_valid=kv_valid, scale=scale),
+        grid=grid,
+        in_specs=[pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0)),
+                  kv_spec, kv_spec],
+        out_specs=[pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, block, 8), lambda b, i: (b, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct((bh, s, 8), jnp.float32)],
+        interpret=_use_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+# --------------------------------------------------------------- backward --
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               block_k: int, causal: bool, kv_valid, scale: float):
+    bq = q_ref.shape[1]
+    s = k_ref.shape[1]
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, 0:1]                            # (bq, 1)
+    delta = delta_ref[0][:, 0:1]                        # rowsum(do * o)
+
+    n_kb = s // block_k
+    if causal:
+        n_kb = jnp.minimum(n_kb, ((iq + 1) * bq + block_k - 1) // block_k)
+
+    def body(kb, dq):
+        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        sc = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        mask = _masks(iq, kb, bq, block_k, causal, kv_valid)
+        if mask is not None:
+            sc = jnp.where(mask, sc, _NEG)
+        p = jnp.exp(sc - lse)                           # (bq, bk)
+        dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        if mask is not None:
+            ds = jnp.where(mask, ds, 0.0)
+        return dq + jax.lax.dot_general(
+            ds, kblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(
+        0, n_kb, body, jnp.zeros((bq, q_ref.shape[2]), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, block_q: int, causal: bool, kv_valid,
+                scale: float):
+    bk = k_ref.shape[1]
+    s = q_ref.shape[1]
+    ik = pl.program_id(1)
+    kblk = k_ref[0].astype(jnp.float32)
+    vblk = v_ref[0].astype(jnp.float32)
+
+    n_qb = s // block_q
+    start_qb = jnp.int32(0)
+    if causal:
+        start_qb = (ik * bk) // block_q                 # earlier rows masked
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q), 0:1]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q), 0:1]
+        sc = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        mask = _masks(qb, ik, block_q, bk, causal, kv_valid)
+        if mask is not None:
+            sc = jnp.where(mask, sc, _NEG)
+        p = jnp.exp(sc - lse)                           # (bq, bk)
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        if mask is not None:
+            ds = jnp.where(mask, ds, 0.0)
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    d = k_ref.shape[2]
+    dk, dv = jax.lax.fori_loop(
+        start_qb, n_qb, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(causal, kv_valid, block, res, do):
+    q, k, v, o, lse = res
+    bh, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    delta = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                axis=-1, keepdims=True), (bh, s, 8))    # (bh, s, 8)
+    grid = (bh, s // block)
+    full_spec = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0))
+    blk_spec = pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0))
+    row_blk = pl.BlockSpec((1, block, 8), lambda b, i: (b, i, 0))
+    row_full = pl.BlockSpec((1, s, 8), lambda b, i: (b, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=block, causal=causal,
+                          kv_valid=kv_valid, scale=scale),
+        grid=grid,
+        in_specs=[blk_spec, full_spec, full_spec, blk_spec, row_blk,
+                  row_blk],
+        out_specs=blk_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_use_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block, causal=causal,
+                          kv_valid=kv_valid, scale=scale),
+        grid=grid,
+        in_specs=[full_spec, blk_spec, blk_spec, full_spec, row_full,
+                  row_full],
+        out_specs=[blk_spec, blk_spec],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        interpret=_use_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------- public API --
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, kv_valid, block):
+    o, _ = _flash_fwd(q, k, v, causal, kv_valid, block)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, causal, kv_valid, block):
+    o, lse = _flash_fwd(q, k, v, causal, kv_valid, block)
+    return o, (q, k, v, o, lse)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False, block: int = BLOCK) -> jax.Array:
+    """Pallas flash attention; q/k/v (B, S, H, D) -> (B, S, H, D).
+
+    Any S works: inputs are zero-padded to a block multiple and the
+    padded key columns are masked inside the kernels (padded query rows
+    come back zero and are sliced off).  Same math as
+    ops.attention.full_attention to float tolerance, forward and
+    backward.
+    """
+    b, s, h, d = q.shape
+    s_pad = -(-s // block) * block
+    kv_valid = s if s_pad != s else None
+
+    def to_bh(x):
+        x = jnp.moveaxis(x, 2, 1).reshape(b * h, s, d)
+        if s_pad != s:
+            x = jnp.pad(x, ((0, 0), (0, s_pad - s), (0, 0)))
+        return x
+
+    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, kv_valid, block)
+    o = o[:, :s].reshape(b, h, s, d)
+    return jnp.moveaxis(o, 1, 2)
