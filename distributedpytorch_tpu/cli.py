@@ -33,7 +33,8 @@ def _build_engine(cfg: Config, model_name: str, dataset: Dataset,
     model = get_model(model_name, dataset.nb_classes,
                       half_precision=cfg.half_precision,
                       attention=cfg.attention, mesh=mesh,
-                      tensor_parallel=cfg.tensor_parallel)
+                      tensor_parallel=cfg.tensor_parallel,
+                      pipeline_parallel=cfg.pipeline_parallel)
     # Working weighted/focal losses (fixes SURVEY defect #4).
     class_weights = (dataset.class_weights()
                      if cfg.loss in ("weighted_cross_entropy", "focal_loss")
@@ -50,9 +51,13 @@ def _build_engine(cfg: Config, model_name: str, dataset: Dataset,
 
 def _place_state(state, mesh, cfg: Config):
     """Replicated (reference semantics) or model-axis-sharded placement
-    (--model-parallel > 1; see parallel.py)."""
+    (--model-parallel > 1; see parallel.py).  Pipeline runs prefer the
+    stacked (depth,) axis so each stage's block weights live on its own
+    devices."""
     if cfg.model_parallel > 1:
-        return jax.device_put(state, parallel.state_sharding(state, mesh))
+        return jax.device_put(
+            state, parallel.state_sharding(
+                state, mesh, prefer_axis0=cfg.pipeline_parallel))
     return jax.device_put(state, runtime.replicated_sharding(mesh))
 
 
@@ -342,21 +347,25 @@ def run_train(cfg: Config) -> dict:
         raise ValueError(
             f"--grad-accum must be >= 1 and divide the per-replica batch "
             f"size ({cfg.batch_size}); got {cfg.grad_accum}")
-    if (cfg.attention != "full" or cfg.tensor_parallel) \
-            and (model_name != "vit"
-                 or (cfg.attention != "full" and cfg.tensor_parallel)
-                 or (cfg.attention == "ring" and cfg.model_parallel < 2)
-                 or (cfg.tensor_parallel and cfg.model_parallel < 2)):
+    vit_features = (cfg.attention != "full" or cfg.tensor_parallel
+                    or cfg.pipeline_parallel)
+    exclusive = sum((cfg.attention != "full", cfg.tensor_parallel,
+                     cfg.pipeline_parallel)) > 1
+    needs_axis = (cfg.attention == "ring" or cfg.tensor_parallel
+                  or cfg.pipeline_parallel)
+    if vit_features and (model_name != "vit" or exclusive
+                         or (needs_axis and cfg.model_parallel < 2)):
         # the registry enforces this too; checking here fails the run
         # before the dataset load pays for a doomed configuration
         raise ValueError(
-            "--attention ring/flash and --tensor-parallel require "
-            "--model vit; ring and tensor-parallel additionally need "
-            "--model-parallel >= 2 and compose only with --attention "
-            f"full; got model={model_name!r}, "
+            "--attention ring/flash, --tensor-parallel and "
+            "--pipeline-parallel require --model vit, are mutually "
+            "exclusive, and (except flash) need --model-parallel >= 2; "
+            f"got model={model_name!r}, "
             f"model_parallel={cfg.model_parallel}, "
             f"attention={cfg.attention!r}, "
-            f"tensor_parallel={cfg.tensor_parallel}")
+            f"tensor_parallel={cfg.tensor_parallel}, "
+            f"pipeline_parallel={cfg.pipeline_parallel}")
     _validate_ckpt_format(cfg)
     if cfg.use_pretrained:
         # Fail unsupported-arch / missing-path mistakes here, before the

@@ -118,6 +118,11 @@ class Config:
     # hidden sharded over 'model' with SHARDED ACTIVATIONS (parallel.py
     # strategy 2).  Needs model_parallel >= 2; exclusive with ring.
     tensor_parallel: bool = False
+    # GPipe stage parallelism for vit: transformer blocks sharded over
+    # 'model' as pipeline stages, activations handed stage-to-stage via
+    # ppermute (models/vit_pipeline.py).  Needs model_parallel >= 2;
+    # exclusive with ring/flash/tensor-parallel.
+    pipeline_parallel: bool = False
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -202,6 +207,12 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                         "vit: heads + MLP hidden sharded over the 'model' "
                         "mesh axis with sharded activations (requires "
                         "--model-parallel >= 2)")
+    p.add_argument("--pipeline-parallel", action="store_true",
+                   dest="pipelineParallel",
+                   help="GPipe stage parallelism for --model vit: "
+                        "transformer blocks sharded over the 'model' "
+                        "mesh axis as pipeline stages (requires "
+                        "--model-parallel >= 2)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -256,4 +267,5 @@ def config_from_argv(argv=None) -> Config:
         model_parallel=args.modelParallel,
         attention=args.attention,
         tensor_parallel=args.tensorParallel,
+        pipeline_parallel=args.pipelineParallel,
     )

@@ -62,13 +62,17 @@ def _require_model_axis(mesh, what: str) -> None:
 
 def get_model(name: str, num_classes: int, half_precision: bool = True,
               attention: str = "full", mesh=None,
-              tensor_parallel: bool = False) -> nn.Module:
-    """``attention``: 'full' (default, XLA-fused softmax attention) or
+              tensor_parallel: bool = False,
+              pipeline_parallel: bool = False) -> nn.Module:
+    """``attention``: 'full' (default, XLA-fused softmax attention),
     'ring' (sequence-parallel over ``mesh``'s 'model' axis via
-    lax.ppermute — ops/attention.py).  ``tensor_parallel``: Megatron-style
+    lax.ppermute — ops/attention.py), or 'flash' (the Pallas kernel,
+    ops/flash_attention.py).  ``tensor_parallel``: Megatron-style
     sharded-activation TP over the same axis (parallel.make_tp_constrain).
-    Both are vit-family features; requesting them for a CNN is a user
-    error surfaced the CLI way (ValueError -> log-and-exit)."""
+    ``pipeline_parallel``: GPipe stage parallelism over the same axis
+    (models/vit_pipeline.py).  All are vit-family features; requesting
+    them for a CNN is a user error surfaced the CLI way (ValueError ->
+    log-and-exit)."""
     if name not in MODEL_REGISTRY:
         raise ValueError(f"Invalid model name {name!r} "
                          f"(choices: {sorted(MODEL_REGISTRY)})")
@@ -76,6 +80,25 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
         raise ValueError(f"attention must be 'full', 'ring' or 'flash', "
                          f"got {attention!r}")
     dtype = jnp.bfloat16 if half_precision else jnp.float32
+    if pipeline_parallel:
+        if name != "vit":
+            raise ValueError(
+                "--pipeline-parallel applies to the attention model "
+                f"family only (--model vit); {name!r} has no stages")
+        if attention != "full" or tensor_parallel:
+            raise ValueError(
+                "--pipeline-parallel is exclusive with --attention "
+                "ring/flash and --tensor-parallel (the pipelined vit "
+                "hand-rolls its blocks)")
+        from .vit_pipeline import PipelinedViT, make_pipeline_fn
+        from ..runtime import MODEL_AXIS
+
+        _require_model_axis(mesh, "--pipeline-parallel (stage axis)")
+        depth, heads = 4, 4  # PipelinedViT defaults
+        return PipelinedViT(
+            num_classes=num_classes, dtype=dtype, depth=depth, heads=heads,
+            pipeline_fn=make_pipeline_fn(mesh, mesh.shape[MODEL_AXIS],
+                                         depth, heads))
     if attention != "full" or tensor_parallel:
         if name != "vit":
             feature = (f"--attention {attention}" if attention != "full"

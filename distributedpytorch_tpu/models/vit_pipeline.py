@@ -1,0 +1,259 @@
+"""Pipeline-parallel ViT: GPipe-style stage parallelism over the 'model'
+mesh axis — the PP leg of the framework's parallelism taxonomy (dp /
+ZeRO / TP / sequence-parallel ring / PP; the reference has data
+parallelism ONLY, SURVEY §2 checklist).
+
+TPU-native design:
+
+  * the transformer blocks' parameters are STACKED on a leading (depth,)
+    axis and sharded over 'model' — P pipeline stages each hold depth/P
+    blocks' weights; nothing is replicated but the small embed/head ends;
+  * execution is one `jax.shard_map` program: a `lax.scan` over
+    P + M - 1 GPipe ticks, each tick applying this stage's blocks to its
+    current microbatch and handing the activation to the next stage with
+    `lax.ppermute` — neighbor-only ICI traffic, the same pattern as ring
+    attention (ops/attention.py);
+  * every stage computes every tick (idle ticks produce masked garbage) —
+    the standard SPMD-GPipe trade that keeps control flow static for XLA;
+  * the data axis is untouched: batches stay sharded over 'data', so PP
+    composes with data parallelism on the same 2-D mesh;
+  * backward is plain jax AD through the scan + ppermute — the reverse
+    schedule (activations flowing backward through stages) falls out of
+    the transpose of ppermute.
+
+Numerics: the pipeline is EXACTLY a re-scheduling of the sequential
+block chain — tests/test_pipeline.py pins pipelined forward AND
+gradients to the same stacked-parameter blocks applied one after another
+on one device, and trains it end-to-end through the CLI
+(--pipeline-parallel P, vit only).
+
+Blocks are hand-rolled pure functions (not nn sub-modules): the pipeline
+body runs under shard_map over raw stacked arrays, so the math lives in
+`_block_apply` and the module only declares the stacked parameters.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..runtime import DATA_AXIS, MODEL_AXIS
+
+_LN_EPS = 1e-6
+
+
+def _layernorm(x, scale, bias):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + _LN_EPS)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _block_apply(p, x, heads: int):
+    """One pre-LN transformer block; p holds THIS block's (unstacked)
+    params.  Same math as models/vit.py TransformerBlock."""
+    b, s, dim = x.shape
+    head_dim = dim // heads
+    dtype = x.dtype
+
+    h = _layernorm(x, p["ln1_scale"], p["ln1_bias"])
+    qkv = h @ p["qkv_kernel"].astype(dtype) + p["qkv_bias"].astype(dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, heads, head_dim)
+    k = k.reshape(b, s, heads, head_dim)
+    v = v.reshape(b, s, heads, head_dim)
+    scale = 1.0 / np.sqrt(head_dim)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    probs = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    attn = attn.astype(dtype).reshape(b, s, dim)
+    x = x + (attn @ p["proj_kernel"].astype(dtype)
+             + p["proj_bias"].astype(dtype))
+
+    h = _layernorm(x, p["ln2_scale"], p["ln2_bias"])
+    h = h @ p["up_kernel"].astype(dtype) + p["up_bias"].astype(dtype)
+    h = nn.gelu(h)
+    h = h @ p["down_kernel"].astype(dtype) + p["down_bias"].astype(dtype)
+    return x + h
+
+
+def _slice_block(stacked, i):
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+        stacked)
+
+
+def sequential_blocks(stacked, x, heads: int, depth: int):
+    """The unpipelined reference schedule: blocks applied in order."""
+
+    def body(h, i):
+        return _block_apply(_slice_block(stacked, i), h, heads), None
+
+    out, _ = jax.lax.scan(body, x, jnp.arange(depth))
+    return out
+
+
+def _pipeline_local(stacked_local, x, *, heads: int, n_stages: int,
+                    blocks_per_stage: int, n_micro: int):
+    """Per-device GPipe body (runs under shard_map): ``stacked_local`` is
+    this stage's (blocks_per_stage, ...) slice; ``x`` the device-local
+    batch (B_local, S, dim).  Returns this device's (B_local, S, dim)
+    output — only the LAST stage's is real; shard_map's out spec reads it
+    from there."""
+    stage = jax.lax.axis_index(MODEL_AXIS)
+    b, s, dim = x.shape
+    mb = b // n_micro
+    micro = x.reshape(n_micro, mb, s, dim)
+    n_ticks = n_stages + n_micro - 1
+
+    def stage_fn(h):
+        def body(a, i):
+            return _block_apply(_slice_block(stacked_local, i), a,
+                                heads), None
+
+        out, _ = jax.lax.scan(body, h, jnp.arange(blocks_per_stage))
+        return out
+
+    def tick(carry, t):
+        act, out = carry
+        mb_idx = t - stage
+        fresh = jax.lax.dynamic_index_in_dim(
+            micro, jnp.clip(mb_idx, 0, n_micro - 1), 0, keepdims=False)
+        x_in = jnp.where(stage == 0, fresh, act)
+        y = stage_fn(x_in)
+        # hand to the next stage (stage P-1 keeps its result)
+        received = jax.lax.ppermute(
+            y, MODEL_AXIS, [(i, i + 1) for i in range(n_stages - 1)])
+        # last stage stores finished microbatches; inactive ticks write
+        # to the scratch slot n_micro
+        active = ((stage == n_stages - 1) & (mb_idx >= 0)
+                  & (mb_idx < n_micro))
+        slot = jnp.where(active, jnp.clip(mb_idx, 0, n_micro - 1), n_micro)
+        out = jax.lax.dynamic_update_index_in_dim(out, y, slot, 0)
+        return (received, out), None
+
+    # Initial carries must already carry the varying-over-'model' type the
+    # loop outputs have (axis_index/ppermute products) — lax.scan under
+    # shard_map requires carry in/out types to match, so seed them with a
+    # stage-derived zero (same trick as ops/attention.py's ring carry).
+    vzero = (stage * 0).astype(x.dtype)
+    out0 = jnp.zeros((n_micro + 1, mb, s, dim), x.dtype) + vzero
+    (_, out), _ = jax.lax.scan(
+        tick, (jnp.zeros((mb, s, dim), x.dtype) + vzero, out0),
+        jnp.arange(n_ticks))
+    result = out[:n_micro].reshape(b, s, dim)
+    # Only the last stage holds real results; the psum over the masked
+    # values broadcasts them to every stage, making the output provably
+    # replicated over MODEL_AXIS (required by the out spec) — one
+    # activation-sized all-reduce per forward.
+    mask = (stage == n_stages - 1).astype(result.dtype)
+    return jax.lax.psum(result * mask, MODEL_AXIS)
+
+
+def make_pipeline_fn(mesh, n_stages: int, depth: int, heads: int,
+                     n_micro: Optional[int] = None):
+    """(stacked_params, tokens (B,S,dim)) -> (B,S,dim), pipelined over
+    ``mesh``'s 'model' axis.  Closure injected into PipelinedViT."""
+    from jax.sharding import PartitionSpec as P
+
+    if depth % n_stages:
+        raise ValueError(f"depth {depth} not divisible by "
+                         f"--pipeline-parallel {n_stages}")
+    n_micro = n_micro or n_stages
+    blocks_per_stage = depth // n_stages
+
+    def fn(stacked, tokens):
+        b = tokens.shape[0]
+        dp = mesh.shape[DATA_AXIS]
+        shard_batch = b % dp == 0          # init-time dummies are smaller
+        b_local = b // dp if shard_batch else b
+        if b_local % n_micro:
+            # tiny tracing batches (model init): identical math, no
+            # pipeline — keeps shapes unconstrained where perf is moot
+            return sequential_blocks(stacked, tokens, heads, depth)
+        data_spec = (P(DATA_AXIS, None, None) if shard_batch
+                     else P(None, None, None))
+        param_specs = jax.tree_util.tree_map(
+            lambda leaf: P(MODEL_AXIS, *([None] * (leaf.ndim - 1))),
+            stacked)
+        body = functools.partial(
+            _pipeline_local, heads=heads, n_stages=n_stages,
+            blocks_per_stage=blocks_per_stage, n_micro=n_micro)
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(param_specs, data_spec),
+            out_specs=data_spec)(stacked, tokens)
+
+    return fn
+
+
+class PipelinedViT(nn.Module):
+    """ViT with stacked-block parameters and an injectable block
+    executor: ``pipeline_fn`` (make_pipeline_fn) runs the blocks GPipe-
+    style; None runs them sequentially (the numerics reference and the
+    single-device fallback).  Same patch-embed/mean-pool/head structure
+    as models/vit.py, but block params live as (depth, ...) stacks, so
+    its checkpoints are a distinct (documented) layout."""
+
+    num_classes: int = 10
+    patch: int = 4
+    dim: int = 128
+    depth: int = 4
+    heads: int = 4
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    pipeline_fn: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d, dep = self.dim, self.depth
+        x = x.astype(self.dtype)
+        x = nn.Conv(d, (self.patch, self.patch),
+                    strides=(self.patch, self.patch), padding="VALID",
+                    dtype=self.dtype, name="patch_embed")(x)
+        b, gh, gw, _ = x.shape
+        x = x.reshape(b, gh * gw, d)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, gh * gw, d), jnp.float32)
+        x = x + pos.astype(self.dtype)
+
+        # batch_axis=0: fan-in/out computed per block, not across the
+        # stacked (depth,) axis
+        init = nn.initializers.lecun_normal(batch_axis=0)
+        zeros, ones = nn.initializers.zeros, nn.initializers.ones
+
+        def stacked(name, initfn, shape):
+            return self.param(name, initfn, shape, jnp.float32)
+
+        blocks = {
+            "ln1_scale": stacked("ln1_scale", ones, (dep, d)),
+            "ln1_bias": stacked("ln1_bias", zeros, (dep, d)),
+            "qkv_kernel": stacked("qkv_kernel", init, (dep, d, 3 * d)),
+            "qkv_bias": stacked("qkv_bias", zeros, (dep, 3 * d)),
+            "proj_kernel": stacked("proj_kernel", init, (dep, d, d)),
+            "proj_bias": stacked("proj_bias", zeros, (dep, d)),
+            "ln2_scale": stacked("ln2_scale", ones, (dep, d)),
+            "ln2_bias": stacked("ln2_bias", zeros, (dep, d)),
+            "up_kernel": stacked("up_kernel", init,
+                                 (dep, d, self.mlp_ratio * d)),
+            "up_bias": stacked("up_bias", zeros, (dep, self.mlp_ratio * d)),
+            "down_kernel": stacked("down_kernel", init,
+                                   (dep, self.mlp_ratio * d, d)),
+            "down_bias": stacked("down_bias", zeros, (dep, d)),
+        }
+        if self.pipeline_fn is not None:
+            x = self.pipeline_fn(blocks, x)
+        else:
+            x = sequential_blocks(blocks, x, self.heads, dep)
+
+        x = nn.LayerNorm(epsilon=_LN_EPS, dtype=self.dtype)(x)
+        x = jnp.mean(x, axis=1)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)

@@ -53,8 +53,13 @@ MIN_SHARD_ELEMENTS = 2 ** 14
 
 
 def leaf_spec(shape, model_parallel: int,
-              min_elements: int = MIN_SHARD_ELEMENTS) -> P:
+              min_elements: int = MIN_SHARD_ELEMENTS,
+              prefer_axis0: bool = False) -> P:
     """PartitionSpec for one tensor: largest mp-divisible axis -> MODEL_AXIS.
+
+    ``prefer_axis0`` picks axis 0 when divisible (the pipeline-parallel
+    layout: stacked per-stage block parameters live on their stage's
+    devices, so the pipeline's shard_map finds them already in place).
 
     Replicates when the mesh has no model axis to use, the tensor is small,
     or no axis is divisible — sharding must never change which tensors are
@@ -66,14 +71,18 @@ def leaf_spec(shape, model_parallel: int,
                  if shape[i] % model_parallel == 0]
     if not divisible:
         return P()
-    axis = max(divisible, key=lambda i: shape[i])
+    if prefer_axis0 and 0 in divisible:
+        axis = 0
+    else:
+        axis = max(divisible, key=lambda i: shape[i])
     spec = [None] * len(shape)
     spec[axis] = MODEL_AXIS
     return P(*spec)
 
 
 def tree_sharding(tree: Any, mesh: Mesh,
-                  min_elements: int = MIN_SHARD_ELEMENTS) -> Any:
+                  min_elements: int = MIN_SHARD_ELEMENTS,
+                  prefer_axis0: bool = False) -> Any:
     """NamedSharding pytree for any param-shaped tree (params, grads,
     optimizer moments — the rule is shape-only, so moments land on the same
     layout as the params they track)."""
@@ -81,17 +90,18 @@ def tree_sharding(tree: Any, mesh: Mesh,
 
     def one(leaf):
         return NamedSharding(mesh, leaf_spec(np.shape(leaf), mp,
-                                             min_elements))
+                                             min_elements, prefer_axis0))
 
     return jax.tree_util.tree_map(one, tree)
 
 
 def state_sharding(state: Any, mesh: Mesh,
-                   min_elements: int = MIN_SHARD_ELEMENTS) -> Any:
+                   min_elements: int = MIN_SHARD_ELEMENTS,
+                   prefer_axis0: bool = False) -> Any:
     """Sharding tree for a whole TrainState (params + batch_stats +
     opt_state + step).  Scalars and batch stats fall below the size floor
     and stay replicated automatically."""
-    return tree_sharding(state, mesh, min_elements)
+    return tree_sharding(state, mesh, min_elements, prefer_axis0)
 
 
 def make_tp_constrain(mesh: Mesh):
