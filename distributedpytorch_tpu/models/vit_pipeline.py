@@ -139,11 +139,13 @@ def _pipeline_local(stacked_local, x, *, heads: int, n_stages: int,
         out = jax.lax.dynamic_update_index_in_dim(out, y, slot, 0)
         return (received, out), None
 
-    # Initial carries must already carry the varying-over-'model' type the
-    # loop outputs have (axis_index/ppermute products) — lax.scan under
-    # shard_map requires carry in/out types to match, so seed them with a
-    # stage-derived zero (same trick as ops/attention.py's ring carry).
-    vzero = (stage * 0).astype(x.dtype)
+    # Initial carries must already carry the varying type the loop outputs
+    # have: varying over 'model' (axis_index/ppermute products) AND over
+    # 'data' (the microbatches come from the data-sharded input) — lax.scan
+    # under shard_map requires carry in/out vma types to match exactly, so
+    # seed them with a zero derived from BOTH sources (same trick as
+    # ops/attention.py's ring carry, extended to the second mesh axis).
+    vzero = (micro[0, :1, :1, :1] * 0 + stage * 0).astype(x.dtype)
     out0 = jnp.zeros((n_micro + 1, mb, s, dim), x.dtype) + vzero
     (_, out), _ = jax.lax.scan(
         tick, (jnp.zeros((mb, s, dim), x.dtype) + vzero, out0),
