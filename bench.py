@@ -115,7 +115,7 @@ def bench_ours(batch_per_replica: int, steps: int, model_name: str,
                     get_model_input_size(model_name),
                     half_precision=half_precision)
     state = jax.device_put(
-        engine.init_state(utils.root_key(1234), dataset.channels),
+        engine.init_state(utils.root_key(1234)),
         runtime.replicated_sharding(mesh))
 
     key = utils.root_key(1234)
@@ -228,7 +228,7 @@ def bench_ours_streaming(batch_per_replica: int, model_name: str = "cnn",
                     dataset.mean, dataset.std,
                     get_model_input_size(model_name))
     state = jax.device_put(
-        engine.init_state(utils.root_key(1234), dataset.channels),
+        engine.init_state(utils.root_key(1234)),
         runtime.replicated_sharding(mesh))
     key = utils.root_key(1234)
 

@@ -49,6 +49,7 @@ import numpy as np
 from flax import serialization
 from jax.sharding import NamedSharding, PartitionSpec
 
+from .models import vit_pipeline
 from .train.engine import TrainState
 
 _FORMAT_VERSION = 1
@@ -303,9 +304,26 @@ def load_checkpoint(path: str, state: TrainState,
         return _load_orbax(path, state, restore_optimizer)
     payload = _read(path)
     template = jax.device_get(gather_replicated(state))
+    template_sd = serialization.to_state_dict(template)
     if not restore_optimizer:  # test path passes optimizer=None (ref :232)
-        payload["state"]["opt_state"] = serialization.to_state_dict(
-            template).get("opt_state", {})
+        payload["state"]["opt_state"] = template_sd.get("opt_state", {})
+    # A vit checkpoint serves both block layouts: PipelinedViT saves its
+    # transformer params STACKED on (depth,); the plain ViT saves
+    # per-block submodules.  When the saved layout differs from the
+    # requested model's, convert in place — params and the optimizer
+    # moments that mirror them — so `test -f` (and resume) work on a
+    # pipeline-trained checkpoint without a pipeline mesh, and vice
+    # versa (self-describing-checkpoint parity, ref classif.py:214).
+    # msgpack (the reference-contract format) only: orbax restores into
+    # the template's own abstract tree as-laid-out, so a pipeline-trained
+    # ORBAX directory needs --pipeline-parallel (+ mesh) to load.
+    src = vit_pipeline.params_layout(payload["state"].get("params"))
+    dst = vit_pipeline.params_layout(template_sd.get("params"))
+    if src is not None and dst is not None and src != dst:
+        payload["state"] = vit_pipeline.convert_layout(payload["state"],
+                                                       dst)
+        logging.info(f"checkpoint params converted: {src} -> {dst} "
+                     "block layout")
     restored = serialization.from_state_dict(template, payload["state"])
     epoch = int(payload["epoch"]) + 1
     best_valid_loss = float(payload["loss"])
@@ -316,7 +334,8 @@ def load_checkpoint(path: str, state: TrainState,
 def get_checkpoint_model_name(path: str) -> str:
     """ref getCheckpointModelName (utils.py:138-140); both formats."""
     if os.path.isdir(path):
-        require_orbax()  # the load that follows sniffing will need it
+        # meta.json is plain JSON — sniffing needs no orbax; only the
+        # actual restore (_load_orbax) requires the dependency.
         meta_path = os.path.join(path, _ORBAX_META)
         try:
             with open(meta_path) as f:

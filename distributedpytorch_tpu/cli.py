@@ -34,7 +34,8 @@ def _build_engine(cfg: Config, model_name: str, dataset: Dataset,
                       half_precision=cfg.half_precision,
                       attention=cfg.attention, mesh=mesh,
                       tensor_parallel=cfg.tensor_parallel,
-                      pipeline_parallel=cfg.pipeline_parallel)
+                      pipeline_parallel=cfg.pipeline_parallel,
+                      pipeline_microbatches=cfg.pipeline_microbatches)
     # Working weighted/focal losses (fixes SURVEY defect #4).
     class_weights = (dataset.class_weights()
                      if cfg.loss in ("weighted_cross_entropy", "focal_loss")
@@ -351,21 +352,45 @@ def run_train(cfg: Config) -> dict:
                     or cfg.pipeline_parallel)
     exclusive = sum((cfg.attention != "full", cfg.tensor_parallel,
                      cfg.pipeline_parallel)) > 1
-    needs_axis = (cfg.attention == "ring" or cfg.tensor_parallel
-                  or cfg.pipeline_parallel)
+    needs_axis = (cfg.attention in ("ring", "ring_flash")
+                  or cfg.tensor_parallel or cfg.pipeline_parallel)
     if vit_features and (model_name != "vit" or exclusive
                          or (needs_axis and cfg.model_parallel < 2)):
         # the registry enforces this too; checking here fails the run
         # before the dataset load pays for a doomed configuration
         raise ValueError(
-            "--attention ring/flash, --tensor-parallel and "
+            "--attention ring/flash/ring_flash, --tensor-parallel and "
             "--pipeline-parallel require --model vit, are mutually "
-            "exclusive, and (except flash) need --model-parallel >= 2; "
+            "exclusive, and (except single-chip flash) need "
+            "--model-parallel >= 2; "
             f"got model={model_name!r}, "
             f"model_parallel={cfg.model_parallel}, "
             f"attention={cfg.attention!r}, "
             f"tensor_parallel={cfg.tensor_parallel}, "
             f"pipeline_parallel={cfg.pipeline_parallel}")
+    if cfg.pipeline_microbatches and not cfg.pipeline_parallel:
+        raise ValueError(
+            "--pipeline-microbatches requires --pipeline-parallel "
+            "(it sets the GPipe M)")
+    if cfg.pipeline_parallel:
+        # The pipeline must actually engage: the per-data-shard batch the
+        # MODEL sees has to hold >= M microbatch rows, else it would
+        # degrade to the sequential schedule the user explicitly opted
+        # out of.  batch_size is PER-REPLICA; the global batch
+        # (batch * world) is sharded over world/model_parallel data
+        # shards, so each shard sees batch * model_parallel rows — and
+        # grad accumulation slices that by K again before the model
+        # applies (engine.py stride-k microbatches).
+        n_micro = cfg.pipeline_microbatches or cfg.model_parallel
+        b_local = cfg.batch_size * cfg.model_parallel // cfg.grad_accum
+        if b_local < n_micro or b_local % n_micro:
+            raise ValueError(
+                f"--pipeline-parallel needs the per-data-shard batch "
+                f"seen by the model (-b {cfg.batch_size} x "
+                f"model_parallel {cfg.model_parallel} / grad_accum "
+                f"{cfg.grad_accum} = {b_local}) to be a multiple of the "
+                f"{n_micro} pipeline microbatches; raise -b or lower "
+                f"--pipeline-microbatches/--grad-accum")
     _validate_ckpt_format(cfg)
     if cfg.use_pretrained:
         # Fail unsupported-arch / missing-path mistakes here, before the
@@ -398,7 +423,7 @@ def run_train(cfg: Config) -> dict:
     engine = _build_engine(cfg, model_name, dataset, len(train_loader),
                            mesh=mesh)
     root = utils.root_key(cfg.seed)
-    state = engine.init_state(root, dataset.channels)
+    state = engine.init_state(root)
 
     if cfg.checkpoint_file:
         if os.path.isdir(cfg.checkpoint_file):
@@ -553,7 +578,7 @@ def run_test(cfg: Config) -> dict:
 
     engine = _build_engine(cfg, model_name, dataset, len(test_loader),
                            mesh=mesh)
-    template = engine.init_state(utils.root_key(cfg.seed), dataset.channels)
+    template = engine.init_state(utils.root_key(cfg.seed))
     if os.path.isdir(cfg.checkpoint_file):
         # orbax: restore straight into the final layout (see run_train)
         template = _place_state(template, mesh, cfg)

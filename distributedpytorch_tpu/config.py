@@ -112,7 +112,10 @@ class Config:
     # 'ring': sequence-parallel ring attention over the 'model' mesh axis
     # (vit only, needs model_parallel >= 2 — see ops/attention.py);
     # 'flash': the Pallas flash-attention TPU kernel, O(S) memory
-    # (vit only — see ops/flash_attention.py).
+    # (vit only — see ops/flash_attention.py);
+    # 'ring_flash': the composition — ring sequence parallelism whose
+    # per-shard local attention runs the Pallas kernel (O(S_local)
+    # memory AND kernel speed; needs model_parallel >= 2).
     attention: str = "full"
     # Megatron-style tensor parallelism for vit: attention heads + MLP
     # hidden sharded over 'model' with SHARDED ACTIVATIONS (parallel.py
@@ -123,6 +126,11 @@ class Config:
     # ppermute (models/vit_pipeline.py).  Needs model_parallel >= 2;
     # exclusive with ring/flash/tensor-parallel.
     pipeline_parallel: bool = False
+    # Microbatches per pipeline step (GPipe M).  0 = one per stage (the
+    # minimum).  Larger M shrinks the bubble fraction
+    # (P-1)/(M+P-1) at the cost of smaller per-tick matmuls; the
+    # per-device batch must be divisible by M.
+    pipeline_microbatches: int = 0
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -155,6 +163,10 @@ def _common_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--data-mode", choices=("auto", "stream", "resident"),
                    default="auto", dest="dataMode",
                    help="device-resident vs streamed batches (default: auto)")
+    p.add_argument("--prefetch", type=int, default=NUM_WORKERS, metavar="N",
+                   help="streamed-mode device prefetch depth (the ref "
+                        f"NUM_WORKERS analogue; default {NUM_WORKERS}; "
+                        "0 = strictly synchronous)")
     p.add_argument("--feature-extract", action="store_true",
                    dest="featureExtract", default=FEATURE_EXTRACT,
                    help="freeze the backbone, train only the classifier "
@@ -194,13 +206,23 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                    help="shard large param/optimizer tensors over an "
                         "N-way 'model' mesh axis (must divide the device "
                         "count; default 1 = replicated)")
-    p.add_argument("--attention", choices=("full", "ring", "flash"),
+    p.add_argument("--attention",
+                   choices=("full", "ring", "flash", "ring_flash"),
                    default="full",
                    help="attention implementation for --model vit: XLA "
                         "softmax (default), sequence-parallel ring "
                         "attention over the 'model' mesh axis (requires "
-                        "--model-parallel >= 2), or the Pallas "
-                        "flash-attention kernel (O(S) memory)")
+                        "--model-parallel >= 2), the Pallas "
+                        "flash-attention kernel (O(S) memory), or "
+                        "ring_flash — the ring with the Pallas kernel "
+                        "inside each shard")
+    p.add_argument("--pipeline-microbatches", type=int, default=0,
+                   dest="pipelineMicrobatches", metavar="M",
+                   help="GPipe microbatches per step for "
+                        "--pipeline-parallel (default 0 = one per "
+                        "stage); larger M shrinks the pipeline bubble "
+                        "(P-1)/(M+P-1); per-device batch must divide "
+                        "by M")
     p.add_argument("--tensor-parallel", action="store_true",
                    dest="tensorParallel",
                    help="Megatron-style tensor parallelism for --model "
@@ -259,6 +281,7 @@ def config_from_argv(argv=None) -> Config:
         debug=args.debug,
         half_precision=not args.no_bf16,
         data_mode=args.dataMode,
+        prefetch=args.prefetch,
         synthetic_fallback=args.syntheticFallback,
         profile=args.profile,
         epochs_per_dispatch=args.epochsPerDispatch,
@@ -268,4 +291,5 @@ def config_from_argv(argv=None) -> Config:
         attention=args.attention,
         tensor_parallel=args.tensorParallel,
         pipeline_parallel=args.pipelineParallel,
+        pipeline_microbatches=args.pipelineMicrobatches,
     )

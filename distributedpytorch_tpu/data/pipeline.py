@@ -110,7 +110,12 @@ class ShardedLoader:
         self.split = split
         self.mesh = mesh
         self.batch_per_replica = batch_per_replica
-        self.prefetch = max(1, prefetch)
+        # prefetch=0: strictly synchronous put->step alternation.  On the
+        # virtual-CPU test mesh an H2D transfer still in flight while an
+        # 8-participant all-reduce executes can deadlock XLA:CPU's
+        # collective rendezvous (single physical core); real TPUs overlap
+        # these fine, so 0 is only for that environment.
+        self.prefetch = max(0, prefetch)
         self.world = mesh.devices.size
         self.sharding = NamedSharding(mesh, P(DATA_AXIS))
 
@@ -152,8 +157,12 @@ class ShardedLoader:
     def epoch(self, epoch: int) -> Iterator[Tuple[jax.Array, jax.Array,
                                                   jax.Array]]:
         """Async-prefetched iterator over one epoch's sharded batches."""
-        queue = collections.deque()
         host_iter = self._host_batches(epoch)
+        if self.prefetch == 0:
+            for arrays in host_iter:
+                yield self._to_device(arrays)
+            return
+        queue = collections.deque()
         try:
             while len(queue) < self.prefetch:
                 queue.append(self._to_device(next(host_iter)))

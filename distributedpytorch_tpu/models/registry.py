@@ -63,11 +63,14 @@ def _require_model_axis(mesh, what: str) -> None:
 def get_model(name: str, num_classes: int, half_precision: bool = True,
               attention: str = "full", mesh=None,
               tensor_parallel: bool = False,
-              pipeline_parallel: bool = False) -> nn.Module:
+              pipeline_parallel: bool = False,
+              pipeline_microbatches: int = 0) -> nn.Module:
     """``attention``: 'full' (default, XLA-fused softmax attention),
     'ring' (sequence-parallel over ``mesh``'s 'model' axis via
-    lax.ppermute — ops/attention.py), or 'flash' (the Pallas kernel,
-    ops/flash_attention.py).  ``tensor_parallel``: Megatron-style
+    lax.ppermute — ops/attention.py), 'flash' (the Pallas kernel,
+    ops/flash_attention.py), or 'ring_flash' (the composition: ring
+    sequence parallelism running the Pallas kernel within each
+    shard).  ``tensor_parallel``: Megatron-style
     sharded-activation TP over the same axis (parallel.make_tp_constrain).
     ``pipeline_parallel``: GPipe stage parallelism over the same axis
     (models/vit_pipeline.py).  All are vit-family features; requesting
@@ -76,9 +79,9 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
     if name not in MODEL_REGISTRY:
         raise ValueError(f"Invalid model name {name!r} "
                          f"(choices: {sorted(MODEL_REGISTRY)})")
-    if attention not in ("full", "ring", "flash"):
-        raise ValueError(f"attention must be 'full', 'ring' or 'flash', "
-                         f"got {attention!r}")
+    if attention not in ("full", "ring", "flash", "ring_flash"):
+        raise ValueError(f"attention must be 'full', 'ring', 'flash' or "
+                         f"'ring_flash', got {attention!r}")
     dtype = jnp.bfloat16 if half_precision else jnp.float32
     if pipeline_parallel:
         if name != "vit":
@@ -94,11 +97,17 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
         from ..runtime import MODEL_AXIS
 
         _require_model_axis(mesh, "--pipeline-parallel (stage axis)")
-        depth, heads = 4, 4  # PipelinedViT defaults
+        if pipeline_microbatches < 0:
+            raise ValueError("--pipeline-microbatches must be >= 0, got "
+                             f"{pipeline_microbatches}")
+        # single source of truth: the model's own field defaults
+        depth, heads = PipelinedViT.depth, PipelinedViT.heads
         return PipelinedViT(
             num_classes=num_classes, dtype=dtype, depth=depth, heads=heads,
             pipeline_fn=make_pipeline_fn(mesh, mesh.shape[MODEL_AXIS],
-                                         depth, heads))
+                                         depth, heads,
+                                         n_micro=pipeline_microbatches
+                                         or None))
     if attention != "full" or tensor_parallel:
         if name != "vit":
             feature = (f"--attention {attention}" if attention != "full"
@@ -114,11 +123,13 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
         from .vit import ViT
 
         attn_fn = None
-        if attention == "ring":
+        if attention in ("ring", "ring_flash"):
             from ..ops.attention import make_ring_attention
 
-            _require_model_axis(mesh, "--attention ring (token axis)")
-            attn_fn = make_ring_attention(mesh)
+            _require_model_axis(mesh, f"--attention {attention} "
+                                      "(token axis)")
+            attn_fn = make_ring_attention(
+                mesh, use_flash=attention == "ring_flash")
         elif attention == "flash":
             # the Pallas flash kernel (ops/flash_attention.py): O(S)
             # memory, single-device; no mesh requirement

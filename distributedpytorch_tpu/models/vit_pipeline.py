@@ -176,10 +176,17 @@ def make_pipeline_fn(mesh, n_stages: int, depth: int, heads: int,
         dp = mesh.shape[DATA_AXIS]
         shard_batch = b % dp == 0          # init-time dummies are smaller
         b_local = b // dp if shard_batch else b
-        if b_local % n_micro:
+        if b_local < n_micro:
             # tiny tracing batches (model init): identical math, no
             # pipeline — keeps shapes unconstrained where perf is moot
             return sequential_blocks(stacked, tokens, heads, depth)
+        if b_local % n_micro:
+            # A REAL batch that doesn't divide must not silently fall
+            # back to the sequential schedule (the user asked for a
+            # pipeline); cli.py validates this up front for product runs.
+            raise ValueError(
+                f"per-device batch {b_local} not divisible by "
+                f"pipeline microbatches {n_micro}")
         data_spec = (P(DATA_AXIS, None, None) if shard_batch
                      else P(None, None, None))
         param_specs = jax.tree_util.tree_map(
@@ -194,6 +201,87 @@ def make_pipeline_fn(mesh, n_stages: int, depth: int, heads: int,
             out_specs=data_spec)(stacked, tokens)
 
     return fn
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint layout conversion: PipelinedViT stores block params STACKED on
+# a leading (depth,) axis; the plain ViT (models/vit.py) stores them as
+# per-block submodules block{i}/{qkv,proj,mlp_up,mlp_down,LayerNorm_0,_1}.
+# The math is identical (tests/test_pipeline.py pins the schedules equal),
+# so a checkpoint from either can serve the other: checkpoint.py calls
+# convert_layout at load time when the saved layout differs from the
+# requested model's (ref parity anchor: self-describing checkpoints,
+# classif.py:214 — eval must work from the file alone).
+
+# stacked name -> (block submodule, leaf) in plain-ViT naming
+_STACK_TO_BLOCK = {
+    "ln1_scale": ("LayerNorm_0", "scale"),
+    "ln1_bias": ("LayerNorm_0", "bias"),
+    "qkv_kernel": ("qkv", "kernel"),
+    "qkv_bias": ("qkv", "bias"),
+    "proj_kernel": ("proj", "kernel"),
+    "proj_bias": ("proj", "bias"),
+    "ln2_scale": ("LayerNorm_1", "scale"),
+    "ln2_bias": ("LayerNorm_1", "bias"),
+    "up_kernel": ("mlp_up", "kernel"),
+    "up_bias": ("mlp_up", "bias"),
+    "down_kernel": ("mlp_down", "kernel"),
+    "down_bias": ("mlp_down", "bias"),
+}
+
+
+def params_layout(sd) -> Optional[str]:
+    """'stacked' (PipelinedViT) | 'blocks' (ViT) | None for a params-like
+    mapping (state dict or live tree)."""
+    if not isinstance(sd, dict):
+        return None
+    if all(k in sd for k in _STACK_TO_BLOCK):
+        return "stacked"
+    if "block0" in sd and isinstance(sd["block0"], dict) \
+            and "qkv" in sd["block0"]:
+        return "blocks"
+    return None
+
+
+def _stacked_to_blocks(sd: dict) -> dict:
+    depth = int(np.shape(sd["qkv_kernel"])[0])
+    out = {k: v for k, v in sd.items() if k not in _STACK_TO_BLOCK}
+    for i in range(depth):
+        blk: dict = {}
+        for stacked_name, (sub, leaf) in _STACK_TO_BLOCK.items():
+            blk.setdefault(sub, {})[leaf] = np.asarray(sd[stacked_name])[i]
+        out[f"block{i}"] = blk
+    return out
+
+
+def _blocks_to_stacked(sd: dict) -> dict:
+    blocks = sorted((k for k in sd if k.startswith("block")
+                     and k[5:].isdigit()), key=lambda s: int(s[5:]))
+    out = {k: v for k, v in sd.items() if k not in blocks}
+    for stacked_name, (sub, leaf) in _STACK_TO_BLOCK.items():
+        out[stacked_name] = np.stack(
+            [np.asarray(sd[b][sub][leaf]) for b in blocks])
+    return out
+
+
+def convert_layout(tree, target: str):
+    """Recursively convert every params-shaped subtree of ``tree`` (a
+    checkpoint state dict: params AND the optimizer moments, which mirror
+    the params structure) to ``target`` ('stacked' | 'blocks').  Subtrees
+    already in the target layout — and non-params leaves like step/count —
+    pass through untouched."""
+    if target not in ("stacked", "blocks"):
+        raise ValueError(f"unknown layout {target!r}")
+    layout = params_layout(tree)
+    if layout == target:
+        return tree
+    if layout == "stacked":
+        return _stacked_to_blocks(tree)
+    if layout == "blocks":
+        return _blocks_to_stacked(tree)
+    if isinstance(tree, dict):
+        return {k: convert_layout(v, target) for k, v in tree.items()}
+    return tree
 
 
 class PipelinedViT(nn.Module):

@@ -18,6 +18,7 @@ tests/test_attention.py on the 8-device virtual mesh.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -51,14 +52,19 @@ def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 _MASKED = -1e30
 
 
-def _ring_body(carry, _, *, axis_name: str, n_dev: int, scale: float,
-               q_pos, causal: bool, kv_valid):
+def _ring_body(carry, t, *, axis_name: str, n_dev: int, s_local: int,
+               scale: float, q_pos, causal: bool, kv_valid, idx):
     """One ring step: attend local Q against the currently-held K/V block,
-    merge into the running flash accumulator, rotate K/V (+ positions) to
-    the next device.  ``kv_valid`` (static int or None) masks padded key
-    positions >= kv_valid — the ragged-sequence support that lets callers
-    pad S up to a multiple of the ring size (see make_ring_attention)."""
-    k_cur, v_cur, k_pos, acc, m, l = carry
+    merge into the running flash accumulator, rotate K/V to the next
+    device.  The held block's GLOBAL positions are a pure function of
+    (device index, step) — block t came from device (idx - t) mod n_dev —
+    so they are computed locally rather than carried and ppermuted (one
+    fewer collective per step).  ``kv_valid`` (static int or None) masks
+    padded key positions >= kv_valid — the ragged-sequence support that
+    lets callers pad S up to a multiple of the ring size (see
+    make_ring_attention)."""
+    k_cur, v_cur, acc, m, l = carry
+    k_pos = ((idx - t) % n_dev) * s_local + jnp.arange(s_local)
 
     scores = jnp.einsum("bqhd,bkhd->bhqk", q_pos[1], k_cur) * scale
     mask = None
@@ -83,8 +89,7 @@ def _ring_body(carry, _, *, axis_name: str, n_dev: int, scale: float,
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
     k_next = jax.lax.ppermute(k_cur, axis_name, perm)
     v_next = jax.lax.ppermute(v_cur, axis_name, perm)
-    kp_next = jax.lax.ppermute(k_pos, axis_name, perm)
-    return (k_next, v_next, kp_next, acc_new, m_new, l_new), None
+    return (k_next, v_next, acc_new, m_new, l_new), None
 
 
 def _ring_attention_local(q, k, v, *, axis_name: str, n_dev: int,
@@ -96,7 +101,6 @@ def _ring_attention_local(q, k, v, *, axis_name: str, n_dev: int,
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     idx = jax.lax.axis_index(axis_name)
     q_glob = idx * s_local + jnp.arange(s_local)
-    k_pos = q_glob  # initially each device holds its own block
 
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
@@ -111,14 +115,91 @@ def _ring_attention_local(q, k, v, *, axis_name: str, n_dev: int,
     l = qt[..., 0] * 0.0
 
     body = functools.partial(_ring_body, axis_name=axis_name, n_dev=n_dev,
-                             scale=scale, q_pos=(q_glob, qf), causal=causal,
-                             kv_valid=kv_valid)
-    (_, _, _, acc, m, l), _ = jax.lax.scan(
-        body, (kf, vf, k_pos, acc, m, l), None, length=n_dev)
+                             s_local=s_local, scale=scale,
+                             q_pos=(q_glob, qf), causal=causal,
+                             kv_valid=kv_valid, idx=idx)
+    (_, _, acc, m, l), _ = jax.lax.scan(
+        body, (kf, vf, acc, m, l), jnp.arange(n_dev))
 
     # Fully-masked rows (padded queries) have l == 0 -> output exactly 0.
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return jnp.einsum("bhqd->bqhd", out).astype(dtype)
+
+
+def _merge_partials(o_run, lse_run, o_blk, lse_blk):
+    """Exact flash combine of two softmax partials over disjoint key
+    sets: each o is its own softmax-normalized result, each lse the
+    log-sum-exp over its keys.  Returns the merged (o, lse)."""
+    lse_new = jnp.logaddexp(lse_run, lse_blk)
+    w_run = jnp.exp(lse_run - lse_new)[..., None]
+    w_blk = jnp.exp(lse_blk - lse_new)[..., None]
+    return o_run * w_run + o_blk.astype(o_run.dtype) * w_blk, lse_new
+
+
+_FAR = 2 ** 30  # padded-position sentinel (>= any kv_valid); plain int —
+#                 a module-level jnp constant would init a backend at import
+
+
+def _ring_local_flash(q, k, v, *, axis_name: str, n_dev: int,
+                      s_local: int, causal: bool, kv_valid, block: int):
+    """Flash-kernel ring body (ring x flash composition): same rotation
+    and flash-merge as _ring_attention_local, but each local block pair
+    is attended by the Pallas kernel (flash_attention_partial) instead
+    of an einsum — the S x S_local score tile now never exists even in
+    VMEM-sized pieces outside the kernel's (128, block) registers.
+    Masking moves to GLOBAL positions carried alongside the rotating
+    K/V (the kernel's _pos_mask), so causal and ragged (kv_valid)
+    support is identical to the einsum ring."""
+    from .flash_attention import flash_attention_partial
+
+    dtype = q.dtype
+    b, s, h, d = q.shape                                # s == s_local
+    idx = jax.lax.axis_index(axis_name)
+    pad = (-s_local) % block
+    s_pad = s_local + pad
+
+    def to_bh(x):
+        x = jnp.einsum("bshd->bhsd", x).reshape(b * h, s, d)
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        return x
+
+    qbh, kbh, vbh = to_bh(q), to_bh(k), to_bh(v)
+    pos = idx * s_local + jnp.arange(s_local, dtype=jnp.int32)
+    pad_tail = jnp.full((pad,), _FAR, jnp.int32)
+    if pad:
+        pos = jnp.concatenate([pos, pad_tail])
+    # Padded key columns must always be masked out; when the caller has
+    # no ragged length, the global S works (every real position < S).
+    kv_eff = kv_valid
+    if kv_eff is None and pad:
+        kv_eff = n_dev * s_local
+
+    # Carry seeds derive from the varying inputs (qbh / idx) so scan
+    # carry in/out vma types match under shard_map.
+    o0 = qbh.astype(jnp.float32) * 0.0
+    lse0 = o0[..., 0] + _MASKED
+
+    def body(carry, t):
+        k_cur, v_cur, o_run, lse_run = carry
+        # block t came from device (idx - t) mod n_dev: its positions
+        # are a pure local function — no need to rotate them
+        k_pos = (((idx - t) % n_dev) * s_local
+                 + jnp.arange(s_local, dtype=jnp.int32))
+        if pad:
+            k_pos = jnp.concatenate([k_pos, pad_tail])
+        o_blk, lse_blk = flash_attention_partial(
+            qbh, k_cur, v_cur, pos, k_pos, causal, kv_eff, block)
+        o_run, lse_run = _merge_partials(o_run, lse_run, o_blk, lse_blk)
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, o_run, lse_run), None
+
+    (_, _, o_run, _), _ = jax.lax.scan(
+        body, (kbh, vbh, o0, lse0), jnp.arange(n_dev))
+    out = o_run[:, :s_local].reshape(b, h, s, d)
+    return jnp.einsum("bhsd->bshd", out).astype(dtype)
 
 
 def _seq_spec(mesh: Mesh, axis_name: str, shard_batch: bool = True) -> P:
@@ -133,8 +214,42 @@ def _seq_spec(mesh: Mesh, axis_name: str, shard_batch: bool = True) -> P:
 
 @functools.lru_cache(maxsize=32)
 def _ring_jitted(mesh: Mesh, axis_name: str, n_dev: int, s_local: int,
-                 causal: bool, kv_valid, shard_batch: bool):
+                 causal: bool, kv_valid, shard_batch: bool,
+                 use_flash: bool = False):
     spec = _seq_spec(mesh, axis_name, shard_batch)
+    if use_flash:
+        from .flash_attention import BLOCK, _use_interpret
+
+        # Kernel block policy (probed on the real chip, round 4):
+        #   * hardware: Mosaic only lowers the full 128-row tile
+        #     (sub-128 blocks fail to compile), so the kernel engages
+        #     when the local sequence fills a tile; shorter shards fall
+        #     back to the einsum ring — identical numerics, and at
+        #     s_local << 128 the padded tile would be mostly-wasted
+        #     FLOPs anyway (the kernel's regime is long S);
+        #   * interpret mode (the CPU-mesh tests): an adaptive small
+        #     block (sublane multiple of 8) keeps the REAL kernel code
+        #     exercised at test-sized shards without 16x padding.
+        if _use_interpret():
+            blk = min(BLOCK, -(-s_local // 8) * 8)
+        elif s_local >= BLOCK:
+            blk = BLOCK
+        else:
+            use_flash = False
+            blk = None
+    if use_flash:
+        fn = functools.partial(_ring_local_flash, axis_name=axis_name,
+                               n_dev=n_dev, s_local=s_local, causal=causal,
+                               kv_valid=kv_valid, block=blk)
+        # check_vma=False: pallas_call's interpret-mode executor (the CPU
+        # mesh tests) does block fetches whose index operands are
+        # unvarying, which the strict varying-manual-axes checker rejects
+        # (JAX's own error suggests this exact workaround).  Correctness
+        # is pinned value-wise against full_attention in
+        # tests/test_attention.py instead.
+        return jax.jit(jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False))
     fn = functools.partial(_ring_attention_local, axis_name=axis_name,
                            n_dev=n_dev, s_local=s_local, causal=causal,
                            kv_valid=kv_valid)
@@ -149,7 +264,8 @@ def _batch_shardable(mesh: Mesh, axis_name: str, b: int) -> bool:
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    axis_name: str = "model", causal: bool = False,
-                   kv_valid: int = None) -> jax.Array:
+                   kv_valid: Optional[int] = None,
+                   use_flash: bool = False) -> jax.Array:
     """Sequence-parallel attention over `mesh`'s `axis_name` axis.
 
     q/k/v: GLOBAL (B, S, H, D) arrays with S sharded over `axis_name`
@@ -164,9 +280,17 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     full_attention's result on the first kv_valid positions
     (make_ring_attention packages that pattern).
 
+    ``use_flash`` computes each ring step's local attention with the
+    Pallas flash kernel (flash_attention_partial) instead of einsum —
+    same numerics, O(S_local) memory AND kernel speed within a shard
+    (the ring x flash composition; see _ring_local_flash).  On hardware
+    the kernel engages when S_local >= 128 (a full MXU tile — also the
+    regime where it pays); shorter shards run the einsum ring body with
+    identical numerics (see the block policy in _ring_jitted).
+
     The jitted shard_map program is cached on (mesh, axis, shape, causal,
-    kv_valid), so repeated calls (e.g. every ViT block, every step) are
-    cache hits.
+    kv_valid, use_flash), so repeated calls (e.g. every ViT block, every
+    step) are cache hits.
     """
     n_dev = mesh.shape[axis_name]
     s = q.shape[1]
@@ -177,29 +301,32 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
         raise ValueError(f"kv_valid={kv_valid} out of range (0, {s}]")
     return _ring_jitted(mesh, axis_name, n_dev, s // n_dev, causal,
                         kv_valid,
-                        _batch_shardable(mesh, axis_name, q.shape[0])
-                        )(q, k, v)
+                        _batch_shardable(mesh, axis_name, q.shape[0]),
+                        use_flash)(q, k, v)
 
 
 def make_ring_attention(mesh: Mesh, axis_name: str = "model",
-                        causal: bool = False):
+                        causal: bool = False, use_flash: bool = False):
     """An ``attention_fn`` closure for models (models/vit.py): pads the
     token axis up to a multiple of the ring size, runs ring attention with
     the padded keys masked (kv_valid), and slices the padding back off —
     so ANY sequence length works, and the result equals full_attention on
     the real tokens (ViT at 28x28/patch-4 has 49 tokens; the 8-device ring
-    pads to 56).  This is what the CLI's ``--attention ring`` installs."""
+    pads to 56).  This is what the CLI's ``--attention ring`` installs
+    (``--attention ring_flash`` passes use_flash=True)."""
     n_dev = mesh.shape[axis_name]
 
     def attn(q, k, v):
         s = q.shape[1]
         pad = (-s) % n_dev
         if pad == 0:
-            return ring_attention(q, k, v, mesh, axis_name, causal=causal)
+            return ring_attention(q, k, v, mesh, axis_name, causal=causal,
+                                  use_flash=use_flash)
         width = ((0, 0), (0, pad), (0, 0), (0, 0))
         out = ring_attention(
             jnp.pad(q, width), jnp.pad(k, width), jnp.pad(v, width),
-            mesh, axis_name, causal=causal, kv_valid=s)
+            mesh, axis_name, causal=causal, kv_valid=s,
+            use_flash=use_flash)
         return out[:, :s]
 
     return attn

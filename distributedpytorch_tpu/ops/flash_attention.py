@@ -57,6 +57,14 @@ def _masks(iq, kb, bq, bk, causal, kv_valid):
         return None
     rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return _pos_mask(rows, cols, causal, kv_valid)
+
+
+def _pos_mask(rows, cols, causal, kv_valid):
+    """Mask from (bq, 1) row / (1, bk) col GLOBAL positions (broadcasts
+    to (bq, bk)), or None.  kv_valid compares against the global
+    position, so it composes with arbitrary position layouts (the ring's
+    rotating K/V blocks)."""
     mask = None
     if causal:
         mask = rows >= cols
@@ -68,8 +76,13 @@ def _masks(iq, kb, bq, bk, causal, kv_valid):
 
 # ---------------------------------------------------------------- forward --
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                causal: bool, kv_valid, scale: float):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k: int,
+                causal: bool, kv_valid, scale: float, use_pos: bool = False):
+    if use_pos:
+        qpos_ref, kpos_ref, o_ref, lse_ref = rest
+        rows = qpos_ref[0][:, 0:1]                      # (bq, 1) global pos
+    else:
+        o_ref, lse_ref = rest
     bq = q_ref.shape[1]
     d = q_ref.shape[2]
     s = k_ref.shape[1]
@@ -77,8 +90,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     q = q_ref[0].astype(jnp.float32) * scale            # (bq, d)
 
     n_kb = s // block_k
-    if causal:
-        # blocks strictly above the diagonal contribute nothing
+    if causal and not use_pos:
+        # blocks strictly above the diagonal contribute nothing (valid
+        # only for the aligned 0-based layout; positions are arbitrary)
         n_kb = jnp.minimum(n_kb, ((iq + 1) * bq + block_k - 1) // block_k)
 
     def body(kb, carry):
@@ -87,7 +101,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         vblk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         sc = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        mask = _masks(iq, kb, bq, block_k, causal, kv_valid)
+        if use_pos:
+            cols = kpos_ref[0, 0:1, pl.ds(kb * block_k, block_k)]
+            mask = _pos_mask(rows, cols, causal, kv_valid)
+        else:
+            mask = _masks(iq, kb, bq, block_k, causal, kv_valid)
         if mask is not None:
             sc = jnp.where(mask, sc, _NEG)
         m_blk = jnp.max(sc, axis=-1, keepdims=True)
@@ -115,30 +133,67 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     lse_ref[0] = jnp.broadcast_to(m + jnp.log(l_safe), (bq, 8))
 
 
-def _flash_fwd(q, k, v, causal: bool, kv_valid, block: int):
+def _out_struct(shape, dtype, *join_of):
+    """ShapeDtypeStruct for a pallas output; under shard_map (vma-typed
+    inputs) the output's varying-manual-axes must be declared explicitly
+    — it is the join of the inputs'."""
+    vma = frozenset()
+    for x in join_of:
+        vma = vma | frozenset(getattr(jax.typeof(x), "vma", ()) or ())
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _pos_arrays(q_pos, k_pos, s: int):
+    """(s,) i32 position vectors -> the (1, s, 8) / (1, 8, s) layouts the
+    kernels read.  Rows ride the sublane-8 broadcast (same scheme as the
+    lse output); cols live on the lane axis so a k-block slice of the
+    LAST dim is Mosaic-legal (128-divisible block of the full array)."""
+    qp = jnp.broadcast_to(q_pos.astype(jnp.int32)[None, :, None], (1, s, 8))
+    kp = jnp.broadcast_to(k_pos.astype(jnp.int32)[None, None, :], (1, 8, s))
+    return qp, kp
+
+
+def _flash_fwd(q, k, v, causal: bool, kv_valid, block: int, positions=None,
+               out_dtype=None):
     bh, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
     grid = (bh, s // block)
     kv_spec = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0))
+    in_specs = [pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0)),
+                kv_spec, kv_spec]
+    args = [q, k, v]
+    if positions is not None:
+        qp, kp = _pos_arrays(*positions, s)
+        in_specs += [pl.BlockSpec((1, block, 8), lambda b, i: (0, i, 0)),
+                     pl.BlockSpec((1, 8, s), lambda b, i: (0, 0, 0))]
+        args += [qp, kp]
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, block_k=block, causal=causal,
-                          kv_valid=kv_valid, scale=scale),
+                          kv_valid=kv_valid, scale=scale,
+                          use_pos=positions is not None),
         grid=grid,
-        in_specs=[pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0)),
-                  kv_spec, kv_spec],
+        in_specs=in_specs,
         out_specs=[pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0)),
                    pl.BlockSpec((1, block, 8), lambda b, i: (b, i, 0))],
-        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
-                   jax.ShapeDtypeStruct((bh, s, 8), jnp.float32)],
+        out_shape=[_out_struct(q.shape, out_dtype or q.dtype, *args),
+                   _out_struct((bh, s, 8), jnp.float32, *args)],
         interpret=_use_interpret(),
-    )(q, k, v)
+    )(*args)
     return o, lse
 
 
 # --------------------------------------------------------------- backward --
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               block_k: int, causal: bool, kv_valid, scale: float):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+               block_k: int, causal: bool, kv_valid, scale: float,
+               use_pos: bool = False):
+    if use_pos:
+        qpos_ref, kpos_ref, dq_ref = rest
+        rows = qpos_ref[0][:, 0:1]                      # (bq, 1)
+    else:
+        (dq_ref,) = rest
     bq = q_ref.shape[1]
     s = k_ref.shape[1]
     iq = pl.program_id(1)
@@ -148,7 +203,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     delta = delta_ref[0][:, 0:1]                        # rowsum(do * o)
 
     n_kb = s // block_k
-    if causal:
+    if causal and not use_pos:
         n_kb = jnp.minimum(n_kb, ((iq + 1) * bq + block_k - 1) // block_k)
 
     def body(kb, dq):
@@ -156,7 +211,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         vblk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         sc = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32) * scale
-        mask = _masks(iq, kb, bq, block_k, causal, kv_valid)
+        if use_pos:
+            cols = kpos_ref[0, 0:1, pl.ds(kb * block_k, block_k)]
+            mask = _pos_mask(rows, cols, causal, kv_valid)
+        else:
+            mask = _masks(iq, kb, bq, block_k, causal, kv_valid)
         if mask is not None:
             sc = jnp.where(mask, sc, _NEG)
         p = jnp.exp(sc - lse)                           # (bq, bk)
@@ -174,9 +233,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, block_q: int, causal: bool, kv_valid,
-                scale: float):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                block_q: int, causal: bool, kv_valid, scale: float,
+                use_pos: bool = False):
+    if use_pos:
+        qpos_ref, kpos_ref, dk_ref, dv_ref = rest
+        cols = kpos_ref[0, 0:1, :]                      # (1, bk)
+    else:
+        dk_ref, dv_ref = rest
     bk = k_ref.shape[1]
     s = q_ref.shape[1]
     ik = pl.program_id(1)
@@ -185,7 +249,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     n_qb = s // block_q
     start_qb = jnp.int32(0)
-    if causal:
+    if causal and not use_pos:
         start_qb = (ik * bk) // block_q                 # earlier rows masked
 
     def body(qb, carry):
@@ -196,7 +260,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, pl.ds(qb * block_q, block_q), 0:1]
         sc = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32) * scale
-        mask = _masks(qb, ik, block_q, bk, causal, kv_valid)
+        if use_pos:
+            rows = qpos_ref[0, pl.ds(qb * block_q, block_q), 0:1]
+            mask = _pos_mask(rows, cols, causal, kv_valid)
+        else:
+            mask = _masks(qb, ik, block_q, bk, causal, kv_valid)
         if mask is not None:
             sc = jnp.where(mask, sc, _NEG)
         p = jnp.exp(sc - lse)                           # (bq, bk)
@@ -221,42 +289,69 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(causal, kv_valid, block, res, do):
-    q, k, v, o, lse = res
+def _flash_bwd_impl(causal, kv_valid, block, q, k, v, o, lse, do,
+                    positions=None, dlse=None):
+    """Two-kernel flash backward.  With ``dlse`` (the cotangent of the
+    log-sum-exp output, used by the ring composition), the correction
+    folds into the delta term: dbar(s_j) = p_j (v_j.do - delta + dlse)
+    because d(lse)/d(s_j) = p_j — so delta := rowsum(do*o) - dlse and
+    the kernels run unchanged."""
     bh, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
-    delta = jnp.broadcast_to(
-        jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                axis=-1, keepdims=True), (bh, s, 8))    # (bh, s, 8)
+    delta_rows = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                         axis=-1, keepdims=True)
+    if dlse is not None:
+        delta_rows = delta_rows - dlse.astype(jnp.float32)[..., None]
+    delta = jnp.broadcast_to(delta_rows, (bh, s, 8))    # (bh, s, 8)
     grid = (bh, s // block)
     full_spec = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0))
     blk_spec = pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0))
     row_blk = pl.BlockSpec((1, block, 8), lambda b, i: (b, i, 0))
     row_full = pl.BlockSpec((1, s, 8), lambda b, i: (b, 0, 0))
+    use_pos = positions is not None
+
+    dq_in_specs = [blk_spec, full_spec, full_spec, blk_spec, row_blk,
+                   row_blk]
+    dkv_in_specs = [full_spec, blk_spec, blk_spec, full_spec, row_full,
+                    row_full]
+    dq_args = [q, k, v, do, lse, delta]
+    dkv_args = [q, k, v, do, lse, delta]
+    if use_pos:
+        qp, kp = _pos_arrays(*positions, s)
+        dq_in_specs += [pl.BlockSpec((1, block, 8), lambda b, i: (0, i, 0)),
+                        pl.BlockSpec((1, 8, s), lambda b, i: (0, 0, 0))]
+        dkv_in_specs += [pl.BlockSpec((1, s, 8), lambda b, i: (0, 0, 0)),
+                         pl.BlockSpec((1, 8, block),
+                                      lambda b, i: (0, 0, i))]
+        dq_args += [qp, kp]
+        dkv_args += [qp, kp]
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, block_k=block, causal=causal,
-                          kv_valid=kv_valid, scale=scale),
+                          kv_valid=kv_valid, scale=scale, use_pos=use_pos),
         grid=grid,
-        in_specs=[blk_spec, full_spec, full_spec, blk_spec, row_blk,
-                  row_blk],
+        in_specs=dq_in_specs,
         out_specs=blk_spec,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=_out_struct(q.shape, q.dtype, *dq_args),
         interpret=_use_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(*dq_args)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, block_q=block, causal=causal,
-                          kv_valid=kv_valid, scale=scale),
+                          kv_valid=kv_valid, scale=scale, use_pos=use_pos),
         grid=grid,
-        in_specs=[full_spec, blk_spec, blk_spec, full_spec, row_full,
-                  row_full],
+        in_specs=dkv_in_specs,
         out_specs=[blk_spec, blk_spec],
-        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
-                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        out_shape=[_out_struct(k.shape, k.dtype, *dkv_args),
+                   _out_struct(v.shape, v.dtype, *dkv_args)],
         interpret=_use_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(*dkv_args)
     return dq, dk, dv
+
+
+def _flash_bwd(causal, kv_valid, block, res, do):
+    q, k, v, o, lse = res
+    return _flash_bwd_impl(causal, kv_valid, block, q, k, v, o, lse, do)
 
 
 # ------------------------------------------------------------- public API --
@@ -273,6 +368,49 @@ def _flash_vjp_fwd(q, k, v, causal, kv_valid, block):
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def flash_attention_partial(q, k, v, q_pos, k_pos, causal, kv_valid,
+                            block=BLOCK):
+    """Partial flash attention over one K/V block with GLOBAL positions:
+    (bh, s, d) q/k/v + (s,) i32 row/col positions -> (o, lse) where o is
+    the softmax-normalized local result and lse the per-row
+    log-sum-exp over THIS block's keys.  Partials over disjoint key
+    blocks merge exactly via the flash combine
+    (ops.attention._merge_partials) — this is the per-shard kernel the
+    ring calls, so sequence-parallel ring attention gets O(S_local)
+    memory AND the MXU-tiled kernel.  Differentiable in q/k/v including
+    the lse output (the merge weights depend on it; see
+    _flash_bwd_impl's delta folding).  The output is FLOAT32 regardless
+    of the input dtype: the caller merges n_dev partials in f32, and a
+    bf16 round-trip per ring step would accumulate n_dev roundings
+    where the plain kernel (and the einsum ring) pay exactly one."""
+    o, lse = _flash_fwd(q, k, v, causal, kv_valid, block, (q_pos, k_pos),
+                        out_dtype=jnp.float32)
+    return o, lse[:, :, 0]
+
+
+def _flash_partial_fwd(q, k, v, q_pos, k_pos, causal, kv_valid, block):
+    o, lse = _flash_fwd(q, k, v, causal, kv_valid, block, (q_pos, k_pos),
+                        out_dtype=jnp.float32)
+    return (o, lse[:, :, 0]), (q, k, v, o, lse, q_pos, k_pos)
+
+
+def _flash_partial_bwd(causal, kv_valid, block, res, cts):
+    import numpy as np
+
+    do, dlse = cts
+    q, k, v, o, lse, q_pos, k_pos = res
+    dq, dk, dv = _flash_bwd_impl(causal, kv_valid, block, q, k, v, o, lse,
+                                 do, positions=(q_pos, k_pos), dlse=dlse)
+    # integer position inputs take float0 cotangents
+    zq = np.zeros(q_pos.shape, jax.dtypes.float0)
+    zk = np.zeros(k_pos.shape, jax.dtypes.float0)
+    return dq, dk, dv, zq, zk
+
+
+flash_attention_partial.defvjp(_flash_partial_fwd, _flash_partial_bwd)
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -107,7 +107,10 @@ class Engine:
 
     # -- state ------------------------------------------------------------
 
-    def init_state(self, key: jax.Array, channels: int) -> TrainState:
+    def init_state(self, key: jax.Array) -> TrainState:
+        # All zoo models see 3-channel input regardless of source channels:
+        # the augment pipeline repeats grayscale to 3ch (ref dataloader.py
+        # TensorRepeat, :31-44), so the init dummy is always (.., .., 3).
         x = jnp.zeros((2, self.input_size, self.input_size, 3),
                       self.compute_dtype)
         variables = jax.jit(
@@ -225,7 +228,13 @@ class Engine:
 
         Documented divergences under K>1: BatchNorm stats are computed per
         microbatch (chained EMA) and dropout draws per microbatch — the
-        same semantics every major framework's accumulation has.
+        same semantics every major framework's accumulation has.  Note
+        also that microbatches are STRIDE-k row slices (rows j, j+k, ...;
+        see ``shard`` below), not contiguous blocks: which rows share a
+        microbatch therefore differs from a contiguous split, so chained
+        BN EMAs and per-row dropout pairings differ from any
+        contiguous-split implementation (gradients remain exact either
+        way — the accumulation identity is order-independent).
         """
         k = self.grad_accum
         b = imgs.shape[0]
@@ -329,7 +338,15 @@ class Engine:
 
     def _epoch_keys(self, state: TrainState, key: jax.Array, n: int):
         """(aug_keys, dropout_keys), each (n, 2) u32 — the same values
-        _train_step would derive per step, batched into one threefry."""
+        _train_step would derive per step, batched into one threefry.
+
+        Correctness contract: assumes the scan body (_train_step_keys via
+        _finish_step) advances state.step by EXACTLY 1 per iteration, so
+        hoisted key i == fold_in(key, state.step + i) matches what the
+        streaming path derives at that step.  tests/test_engine.py::
+        test_epoch_keys_match_streaming_derivation pins this key-level
+        equality so a future change to the step increment fails loudly.
+        """
         step_keys = jax.vmap(
             lambda i: jax.random.fold_in(key, state.step + i)
         )(jnp.arange(n, dtype=jnp.int32))

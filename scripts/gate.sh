@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Pre-commit / pre-snapshot gate (VERDICT r3 item 2): the canary check
+# that MUST be green before any commit touching shard_map / engine /
+# model code — precisely the check that round 3 skipped when it shipped
+# a red multichip gate.
+#
+#   1. canary tests (~4.5 min on this single-core host): the components a
+#      sharding/engine change can break — pipeline schedule + numerics,
+#      sharded==big-batch equivalence, engine mechanics, driver entry
+#   2. the driver's own gate: __graft_entry__.dryrun_multichip(8)
+#      (clean env, exactly as the driver runs it)
+#
+# Tier map:
+#   pytest -m "not slow"   full fast tier (~20 min) — run before snapshots
+#   pytest tests/          everything incl. subprocess worlds (~40+ min)
+#
+# Usage: bash scripts/gate.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== gate: canary tests =="
+python -m pytest tests/test_pipeline.py tests/test_distributed.py \
+    tests/test_graft_entry.py tests/test_engine.py -q -x -m "not slow"
+
+echo "== gate: dryrun_multichip(8) =="
+env -u XLA_FLAGS -u JAX_PLATFORMS python -c \
+  "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun OK')"
+
+echo "== gate GREEN =="

@@ -58,7 +58,7 @@ def main() -> int:
                     dataset.mean, dataset.std,
                     get_model_input_size(args.model))
     state = jax.device_put(
-        engine.init_state(utils.root_key(1234), dataset.channels),
+        engine.init_state(utils.root_key(1234)),
         runtime.replicated_sharding(mesh))
     key = utils.root_key(1234)
     idx, valid = loader.epoch_plan(0)

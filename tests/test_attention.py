@@ -79,7 +79,7 @@ def test_vit_forward_and_train_step():
     tx = make_optimizer("adam", 1e-3, 0.9, 0.1, 10, False)
     engine = Engine(model, "vit", get_loss_fn("cross_entropy"), tx,
                     mean=0.45, std=0.2, input_size=28, half_precision=False)
-    state = engine.init_state(jax.random.PRNGKey(0), 1)
+    state = engine.init_state(jax.random.PRNGKey(0))
 
     rng = np.random.default_rng(0)
     imgs = rng.integers(0, 256, (8, 28, 28), np.uint8)
@@ -138,6 +138,93 @@ def test_make_ring_attention_ragged_matches_full(seq_mesh):
         np.testing.assert_allclose(np.asarray(g), np.asarray(wv),
                                    rtol=5e-5, atol=5e-5,
                                    err_msg=f"d{name} mismatch (ragged)")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_full(qkv, seq_mesh, causal):
+    """The ring x flash composition (each ring step's local attention on
+    the Pallas kernel, interpret mode on the CPU mesh): outputs pinned
+    to full attention, causal included — the kernel masks by GLOBAL
+    positions that rotate with the K/V blocks."""
+    q, k, v = qkv
+    want = attention.full_attention(q, k, v, causal=causal)
+    sharding = attention.sequence_sharding(seq_mesh)
+    qs, ks, vs = (jax.device_put(x, sharding) for x in (q, k, v))
+    got = attention.ring_attention(qs, ks, vs, seq_mesh, causal=causal,
+                                   use_flash=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_gradients_match_full(qkv, seq_mesh, causal):
+    """Gradients through the composition: the flash kernel's lse output
+    feeds the ring merge, so its cotangent must flow back through the
+    kernel's backward (the delta-folding in _flash_bwd_impl) — this is
+    the test that catches a dropped dlse term.  Causal included: the
+    position-masked blocks (fully-masked partials, where exp(sc - lse)
+    relies on exactly-zero cotangents to cancel) must contribute
+    exactly nothing to the gradient."""
+    q, k, v = qkv
+    w = jax.random.normal(jax.random.PRNGKey(7), (B, S, H, D), jnp.float32)
+
+    def loss_full(q, k, v):
+        return jnp.sum(
+            attention.full_attention(q, k, v, causal=causal) * w)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(attention.ring_attention(
+            q, k, v, seq_mesh, causal=causal, use_flash=True) * w)
+
+    want = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    sharding = attention.sequence_sharding(seq_mesh)
+    qs, ks, vs = (jax.device_put(x, sharding) for x in (q, k, v))
+    got = jax.grad(loss_ring, argnums=(0, 1, 2))(qs, ks, vs)
+    for g, wv, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(wv),
+                                   rtol=5e-5, atol=5e-5,
+                                   err_msg=f"d{name} mismatch (ring+flash)")
+
+
+def test_ring_flash_ragged_matches_full(seq_mesh):
+    """make_ring_attention(use_flash=True) — the --attention ring_flash
+    product closure: S=49 pads to 56 across the ring AND to the kernel's
+    block inside each shard; both paddings masked.  Outputs AND
+    gradients pinned (the ragged kv_valid mask must zero padded-key
+    gradient contributions exactly)."""
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q, k, v = (jax.random.normal(kk, (2, 49, 4, 16), jnp.float32)
+               for kk in ks)
+    attn = attention.make_ring_attention(seq_mesh, use_flash=True)
+    want = attention.full_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(attn(q, k, v)), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    w = jax.random.normal(jax.random.PRNGKey(8), (2, 49, 4, 16))
+    g_full = jax.grad(
+        lambda a, b, c: jnp.sum(attention.full_attention(a, b, c) * w),
+        argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.grad(lambda a, b, c: jnp.sum(attn(a, b, c) * w),
+                      argnums=(0, 1, 2))(q, k, v)
+    for g, wv, name in zip(g_ring, g_full, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(wv),
+                                   rtol=5e-5, atol=5e-5,
+                                   err_msg=f"d{name} mismatch "
+                                           "(ring_flash ragged)")
+
+
+def test_ring_flash_bfloat16_io(qkv, seq_mesh):
+    """bf16 in/out (the product dtype): partials stay f32 through the
+    merge — one rounding at the end, same as the plain kernel — so the
+    result matches the f32 reference to bf16 tolerance."""
+    q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
+    want = attention.full_attention(*qkv)  # f32 reference
+    sharding = attention.sequence_sharding(seq_mesh)
+    qs, ks, vs = (jax.device_put(x, sharding) for x in (q, k, v))
+    got = attention.ring_attention(qs, ks, vs, seq_mesh, use_flash=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want), rtol=2e-2, atol=2e-2)
 
 
 def test_ring_long_sequence(seq_mesh):

@@ -6,6 +6,9 @@ import pytest
 from distributedpytorch_tpu.cli import run_train
 from distributedpytorch_tpu.config import Config
 
+# subprocess worlds / full CLI chains: the slow tier (scripts/gate.sh runs -m 'not slow')
+pytestmark = pytest.mark.slow
+
 
 @pytest.mark.parametrize("k", [2])
 def test_chunked_metrics_match_per_epoch(tmp_path, k):

@@ -51,7 +51,7 @@ def test_engine_trains_on_rgb_input():
     tx = make_optimizer("adam", 1e-3, 0.9, 0.1, 10, False)
     eng = Engine(model, "cnn", get_loss_fn("cross_entropy"), tx,
                  mean=0.47, std=0.25, input_size=28, half_precision=False)
-    state = eng.init_state(jax.random.PRNGKey(0), channels=3)
+    state = eng.init_state(jax.random.PRNGKey(0))
     rng = np.random.default_rng(0)
     images = rng.integers(0, 256, size=(16, 32, 32, 3), dtype=np.uint8)
     labels = rng.integers(0, 10, size=(16,)).astype(np.int32)

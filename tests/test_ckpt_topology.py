@@ -42,6 +42,9 @@ import pytest
 from tests._subproc import (REPO, await_all, free_port, launch_logged,
                             wait_for_epoch_line)
 
+# subprocess worlds / full CLI chains: the slow tier (scripts/gate.sh runs -m 'not slow')
+pytestmark = pytest.mark.slow
+
 CHILD = os.path.join(REPO, "tests", "_ckpt_child.py")
 
 

@@ -15,6 +15,9 @@ from distributedpytorch_tpu import checkpoint as ckpt
 from distributedpytorch_tpu.cli import run_test, run_train
 from distributedpytorch_tpu.config import Config, config_from_argv
 
+# subprocess worlds / full CLI chains: the slow tier (scripts/gate.sh runs -m 'not slow')
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def trained(tmp_path_factory):

@@ -43,7 +43,7 @@ def test_sharded_step_equals_single_device_step(model_name):
     images, labels, valid = _global_batch(64)
 
     # 8-way: batch sharded over 'data', params replicated over the mesh.
-    state8 = jax.device_put(eng.init_state(jax.random.PRNGKey(0), 1),
+    state8 = jax.device_put(eng.init_state(jax.random.PRNGKey(0)),
                             runtime.replicated_sharding(mesh8))
     shard = runtime.data_sharding(mesh8)
     s8, m8 = eng.train_step(state8,
@@ -53,7 +53,7 @@ def test_sharded_step_equals_single_device_step(model_name):
 
     # single device: same global batch, same init, same key.
     dev0 = devices[0]
-    state1 = jax.device_put(eng.init_state(jax.random.PRNGKey(0), 1), dev0)
+    state1 = jax.device_put(eng.init_state(jax.random.PRNGKey(0)), dev0)
     s1, m1 = eng.train_step(state1,
                             jax.device_put(images, dev0),
                             jax.device_put(labels, dev0),
@@ -71,7 +71,7 @@ def test_uneven_world_metrics_are_global():
     example exactly once (fixes SURVEY defect #9's shard-local metrics)."""
     mesh8 = runtime.make_mesh()
     eng = _engine()
-    state = jax.device_put(eng.init_state(jax.random.PRNGKey(0), 1),
+    state = jax.device_put(eng.init_state(jax.random.PRNGKey(0)),
                            runtime.replicated_sharding(mesh8))
     images, labels, valid = _global_batch(64)
     valid[60:] = False  # simulate wraparound padding on the last shard

@@ -35,7 +35,7 @@ def _batch(b=16, seed=0):
 
 def test_train_step_reduces_loss_and_increments_step():
     eng = _make_engine()
-    state = eng.init_state(jax.random.PRNGKey(0), channels=1)
+    state = eng.init_state(jax.random.PRNGKey(0))
     # Learnable batch: brightness encodes the label, surviving the random
     # crop/rotation the train step applies on device.
     labels = np.tile(np.arange(10), 7)[:64].astype(np.int32)
@@ -53,7 +53,7 @@ def test_train_step_reduces_loss_and_increments_step():
 
 def test_valid_mask_excludes_padding_from_loss_and_metrics():
     eng = _make_engine()
-    state = eng.init_state(jax.random.PRNGKey(0), channels=1)
+    state = eng.init_state(jax.random.PRNGKey(0))
     images, labels, _ = _batch(8)
     full = eng.eval_step(state, images, labels, np.ones(8, dtype=bool))
     half_mask = np.array([True] * 4 + [False] * 4)
@@ -90,7 +90,7 @@ def test_invalid_optimizer_raises():
 
 def test_feature_extract_freezes_backbone():
     eng = _make_engine(feature_extract=True)
-    state = eng.init_state(jax.random.PRNGKey(0), channels=1)
+    state = eng.init_state(jax.random.PRNGKey(0))
     images, labels, valid = _batch(16)
     before = jax.device_get(state.params)
     state2, _ = eng.train_step(state, images, labels, valid,
@@ -109,14 +109,14 @@ def test_feature_extract_freezes_backbone():
 
 def test_checkpoint_roundtrip_restores_bitwise(tmp_path):
     eng = _make_engine()
-    state = eng.init_state(jax.random.PRNGKey(0), channels=1)
+    state = eng.init_state(jax.random.PRNGKey(0))
     images, labels, valid = _batch(32)
     state, _ = eng.train_step(state, images, labels, valid,
                               jax.random.PRNGKey(1))
     path = str(tmp_path / "ck.ckpt")
     ckpt.save_checkpoint(path, "cnn", state, epoch=3, best_valid_loss=0.25)
 
-    fresh = eng.init_state(jax.random.PRNGKey(7), channels=1)
+    fresh = eng.init_state(jax.random.PRNGKey(7))
     restored, next_epoch, best = ckpt.load_checkpoint(path, fresh)
     assert next_epoch == 4 and best == 0.25     # ref utils.py:133-134
     assert ckpt.get_checkpoint_model_name(path) == "cnn"
@@ -133,7 +133,7 @@ def test_checkpoint_roundtrip_restores_bitwise(tmp_path):
 
 def test_checkpoint_rotation_deletes_previous_epoch(tmp_path):
     eng = _make_engine()
-    state = eng.init_state(jax.random.PRNGKey(0), channels=1)
+    state = eng.init_state(jax.random.PRNGKey(0))
     rsl = str(tmp_path)
     for epoch in range(3):
         ckpt.rotate_checkpoint(rsl, "mnist", "cnn", epoch)
@@ -145,10 +145,34 @@ def test_checkpoint_rotation_deletes_previous_epoch(tmp_path):
     assert files == ["checkpoint-mnist-cnn-002.ckpt"]
 
 
+def test_epoch_keys_match_streaming_derivation():
+    """_epoch_keys hoists per-step PRNG derivation out of the epoch scan
+    assuming state.step advances by exactly 1 per iteration; pin the
+    hoisted keys to the streaming path's per-step fold_in+split at the
+    key level (first, middle and last step) so a change to the step
+    increment breaks loudly here, not as a silent resident!=streaming
+    numerics drift."""
+    eng = _make_engine()
+    state = eng.init_state(jax.random.PRNGKey(0))
+    # advance a few steps so state.step != 0
+    images, labels, valid = _batch(8)
+    for _ in range(3):
+        state, _ = eng.train_step(state, images, labels, valid,
+                                  jax.random.PRNGKey(9))
+    key = jax.random.PRNGKey(5)
+    n = 7
+    aug_keys, dropout_keys = jax.device_get(eng._epoch_keys(state, key, n))
+    for i in (0, n // 2, n - 1):
+        step_key = jax.random.fold_in(key, int(state.step) + i)
+        want_aug, want_drop = jax.device_get(jax.random.split(step_key))
+        np.testing.assert_array_equal(aug_keys[i], want_aug)
+        np.testing.assert_array_equal(dropout_keys[i], want_drop)
+
+
 def test_weighted_loss_engine_path():
     w = np.linspace(0.5, 2.0, 10).astype(np.float32)
     eng = _make_engine(loss="weighted_cross_entropy", class_weights=w)
-    state = eng.init_state(jax.random.PRNGKey(0), channels=1)
+    state = eng.init_state(jax.random.PRNGKey(0))
     images, labels, valid = _batch(16)
     state, metrics = eng.train_step(state, images, labels, valid,
                                     jax.random.PRNGKey(1))

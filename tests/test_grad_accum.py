@@ -49,8 +49,8 @@ def test_accumulated_step_equals_big_batch_step(loss):
 
     e1 = _engine(loss, grad_accum=1)
     e4 = _engine(loss, grad_accum=4)
-    s1 = e1.init_state(jax.random.PRNGKey(0), 1)
-    s4 = e4.init_state(jax.random.PRNGKey(0), 1)
+    s1 = e1.init_state(jax.random.PRNGKey(0))
+    s4 = e4.init_state(jax.random.PRNGKey(0))
 
     s1, m1 = e1.train_step(s1, images, labels, valid, key)
     s4, m4 = e4.train_step(s4, images, labels, valid, key)
@@ -66,7 +66,7 @@ def test_accumulated_step_equals_big_batch_step(loss):
 
 def test_indivisible_microbatch_raises():
     e = _engine("cross_entropy", grad_accum=5)
-    s = e.init_state(jax.random.PRNGKey(0), 1)
+    s = e.init_state(jax.random.PRNGKey(0))
     images, labels, valid = _batch(b=16)
     with pytest.raises(ValueError, match="not divisible"):
         e.train_step(s, images, labels, valid, jax.random.PRNGKey(1))
@@ -97,7 +97,7 @@ def test_grad_accum_with_dropout_model():
     m = get_model("alexnet", 10, half_precision=False)
     e = Engine(m, "alexnet", get_loss_fn("cross_entropy"), tx, mean=0.45,
                std=0.2, input_size=64, half_precision=False, grad_accum=2)
-    s = e.init_state(jax.random.PRNGKey(0), 1)
+    s = e.init_state(jax.random.PRNGKey(0))
     rng = np.random.default_rng(0)
     images = rng.integers(0, 256, size=(4, 64, 64), dtype=np.uint8)
     labels = rng.integers(0, 10, size=(4,)).astype(np.int32)

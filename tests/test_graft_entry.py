@@ -22,6 +22,7 @@ def test_entry_forward_is_jittable():
 
 
 @pytest.mark.skipif(_on_tpu, reason="would force the process onto CPU")
+@pytest.mark.slow
 def test_dryrun_multichip_8():
     graft.dryrun_multichip(8)
 

@@ -1,0 +1,104 @@
+"""Real-MNIST readiness (VERDICT r3 missing #1): a FULL-SIZE fake corpus
+(60k train / 10k test, 28x28 u8) in the torchvision on-disk layout
+(MNIST/raw/*-ubyte.gz, ref dataloader.py:85-96), driven through the real
+CLI — ``python main.py train -d .. `` / ``test -f ..`` as a user runs it
+— covering argv parsing, the ``--dataset mnist`` IDX load, the mean/std
+scan over all 60k pixels, the 90/10 split, one full training epoch,
+checkpointing, and the eval pass.  After this, the only thing about real
+MNIST this suite has not seen is the bytes themselves (no network egress
+here; scripts/fetch_mnist.sh documents the fetch, BASELINE.md row 1b
+holds the placeholder to fill when egress exists).
+
+Runs as a SUBPROCESS on ONE virtual CPU device: at this scale the
+8-virtual-device mesh hits XLA:CPU environment artifacts (a stochastic
+collective-rendezvous deadlock on the single physical core, and
+pathological GSPMD build times for the resident whole-epoch program —
+see __graft_entry__._force_cpu_devices notes).  Multi-device SPMD
+semantics are covered across the rest of the suite; THIS test's subject
+is the real-data path at real size, which is mesh-width independent."""
+
+import gzip
+import os
+import re
+import struct
+import sys
+
+import numpy as np
+import pytest
+
+from tests._subproc import await_all, child_env, launch_logged
+
+pytestmark = pytest.mark.slow
+
+
+def _write_idx_gz(path, arr: np.ndarray) -> None:
+    """MNIST wire format: >HBB magic (0, 0x08=u8, ndim) + >I dims + raw."""
+    header = struct.pack(">HBB", 0, 0x08, arr.ndim)
+    header += struct.pack(">" + "I" * arr.ndim, *arr.shape)
+    with gzip.open(path, "wb", compresslevel=1) as f:
+        f.write(header + arr.tobytes())
+
+
+@pytest.fixture(scope="module")
+def mnist_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("mnist_fullsize")
+    raw = root / "MNIST" / "raw"
+    os.makedirs(raw)
+    rng = np.random.default_rng(1234)
+
+    def corpus(n):
+        # Learnable at full scale: the label is encoded in brightness,
+        # surviving the train-time rotation/crop augmentation.
+        labels = rng.integers(0, 10, size=(n,)).astype(np.uint8)
+        base = (labels.astype(np.int32) * 24 + 12)[:, None, None]
+        noise = rng.integers(-10, 11, size=(n, 28, 28))
+        imgs = np.clip(base + noise, 0, 255).astype(np.uint8)
+        return imgs, labels
+
+    tr_x, tr_y = corpus(60000)
+    te_x, te_y = corpus(10000)
+    _write_idx_gz(raw / "train-images-idx3-ubyte.gz", tr_x)
+    _write_idx_gz(raw / "train-labels-idx1-ubyte.gz", tr_y)
+    _write_idx_gz(raw / "t10k-images-idx3-ubyte.gz", te_x)
+    _write_idx_gz(raw / "t10k-labels-idx1-ubyte.gz", te_y)
+    return str(root)
+
+
+def _run_cli(args, log_path, timeout):
+    env_extra = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    }
+    env = child_env()
+    env.update(env_extra)
+    out = open(log_path, "ab")
+    import subprocess
+
+    from tests._subproc import REPO
+    p = subprocess.Popen([sys.executable, "main.py", *args], cwd=REPO,
+                         env=env, stdout=out, stderr=out)
+    await_all([p], [log_path], timeout=timeout)
+
+
+def test_full_size_mnist_cli_train_and_test(mnist_dir, tmp_path):
+    rsl = str(tmp_path / "rsl")
+    train_log = str(tmp_path / "train_out.txt")
+    _run_cli(["train", "-d", mnist_dir, "--rsl_path", rsl, "--model",
+              "cnn", "-e", "1", "-b", "512", "--no-bf16"],
+             train_log, timeout=1500)
+    log = open(os.path.join(rsl, "test.log")).read()
+    assert "Number of training examples: 54000" in log
+    assert "Number of validation examples: 6000" in log
+    assert re.search(r"Epoch: 0", log), log[-2000:]
+
+    ckpt = os.path.join(rsl, "bestmodel-mnist-cnn.ckpt")
+    assert os.path.exists(ckpt)
+    test_log = str(tmp_path / "test_out.txt")
+    _run_cli(["test", "-d", mnist_dir, "--rsl_path", rsl, "--no-bf16",
+              "-b", "512", "-f", ckpt], test_log, timeout=900)
+    log = open(os.path.join(rsl, "test.log")).read()
+    m = re.search(r"Acc: ([0-9.]+)%", log)
+    assert m, log[-2000:]
+    # brightness encodes the label; one epoch at 54k samples must beat
+    # chance by a wide margin if the full pipeline actually learned
+    assert float(m.group(1)) > 50.0

@@ -25,6 +25,9 @@ import pytest
 
 from tests._subproc import REPO, await_all, free_port, launch_logged
 
+# subprocess worlds / full CLI chains: the slow tier (scripts/gate.sh runs -m 'not slow')
+pytestmark = pytest.mark.slow
+
 CHILD = os.path.join(REPO, "tests", "_mp_child.py")
 NPROC = 2
 DEVICES_PER_PROC = 2

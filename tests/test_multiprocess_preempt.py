@@ -9,8 +9,13 @@ import os
 import signal
 import sys
 
+import pytest
+
 from tests._subproc import (REPO, free_port, launch_logged,
                             wait_for_epoch_line)
+
+# subprocess worlds / full CLI chains: the slow tier (scripts/gate.sh runs -m 'not slow')
+pytestmark = pytest.mark.slow
 
 CHILD = os.path.join(REPO, "tests", "_mp_preempt_child.py")
 

@@ -29,6 +29,7 @@ def trained(tmp_path_factory):
     return cfg, result
 
 
+@pytest.mark.slow
 def test_orbax_checkpoints_are_directories(trained):
     cfg, _ = trained
     rolling = ckpt.checkpoint_path(cfg.rsl_path, "synthetic", "cnn", 0)
@@ -38,6 +39,7 @@ def test_orbax_checkpoints_are_directories(trained):
     assert ckpt.get_checkpoint_model_name(best) == "cnn"
 
 
+@pytest.mark.slow
 def test_orbax_resume_and_test_subcommand(trained):
     cfg, first = trained
     rolling = ckpt.checkpoint_path(cfg.rsl_path, "synthetic", "cnn", 0)
@@ -61,7 +63,7 @@ def test_orbax_roundtrip_bitwise(tmp_path):
     engine = Engine(model, "mlp", get_loss_fn("cross_entropy"), tx,
                     mean=0.45, std=0.2, input_size=28,
                     half_precision=False)
-    state = engine.init_state(jax.random.PRNGKey(7), 1)
+    state = engine.init_state(jax.random.PRNGKey(7))
     rng = np.random.default_rng(0)
     state, _ = engine.train_step(
         state, rng.integers(0, 256, (8, 28, 28), np.uint8),
@@ -70,7 +72,7 @@ def test_orbax_roundtrip_bitwise(tmp_path):
 
     path = str(tmp_path / "ck")
     ckpt.save_checkpoint(path, "mlp", state, 3, 0.25, fmt="orbax")
-    template = engine.init_state(jax.random.PRNGKey(0), 1)
+    template = engine.init_state(jax.random.PRNGKey(0))
     restored, next_epoch, best = ckpt.load_checkpoint(path, template)
     assert next_epoch == 4 and best == 0.25
     for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(state)),
@@ -87,12 +89,12 @@ def test_orbax_saves_sharded_state_without_gather(tmp_path):
                     mean=0.45, std=0.2, input_size=28,
                     half_precision=False)
     mesh = runtime.make_mesh(model_parallel=2)
-    state = engine.init_state(jax.random.PRNGKey(0), 1)
+    state = engine.init_state(jax.random.PRNGKey(0))
     s_mp = jax.device_put(state, parallel.state_sharding(state, mesh))
 
     path = str(tmp_path / "ck")
     ckpt.save_checkpoint(path, "mlp", s_mp, 0, 1.0, fmt="orbax")
-    template = engine.init_state(jax.random.PRNGKey(1), 1)
+    template = engine.init_state(jax.random.PRNGKey(1))
     restored, _, _ = ckpt.load_checkpoint(path, template)
     for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(state)),
                     jax.tree_util.tree_leaves(jax.device_get(restored))):
@@ -124,7 +126,7 @@ def test_orbax_restore_without_optimizer_across_optimizers(tmp_path):
     eng_adam = Engine(model, "mlp", get_loss_fn("cross_entropy"), tx_adam,
                       mean=0.45, std=0.2, input_size=28,
                       half_precision=False)
-    state = eng_adam.init_state(jax.random.PRNGKey(0), 1)
+    state = eng_adam.init_state(jax.random.PRNGKey(0))
     path = str(tmp_path / "ck_adam")
     ckpt.save_checkpoint(path, "mlp", state, 2, 0.5, fmt="orbax")
 
@@ -132,7 +134,7 @@ def test_orbax_restore_without_optimizer_across_optimizers(tmp_path):
     eng_sgd = Engine(model, "mlp", get_loss_fn("cross_entropy"), tx_sgd,
                      mean=0.45, std=0.2, input_size=28,
                      half_precision=False)
-    template = eng_sgd.init_state(jax.random.PRNGKey(1), 1)
+    template = eng_sgd.init_state(jax.random.PRNGKey(1))
     restored, next_epoch, best = ckpt.load_checkpoint(
         path, template, restore_optimizer=False)
     assert next_epoch == 3 and best == 0.5

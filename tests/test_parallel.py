@@ -54,7 +54,7 @@ def test_sharded_step_equals_replicated():
 
     # replicated baseline on the 1-D data mesh
     mesh1 = runtime.make_mesh()
-    s_rep = jax.device_put(engine.init_state(jax.random.PRNGKey(0), 1),
+    s_rep = jax.device_put(engine.init_state(jax.random.PRNGKey(0)),
                            runtime.replicated_sharding(mesh1))
     img1 = jax.device_put(images, runtime.data_sharding(mesh1))
     lab1 = jax.device_put(labels, runtime.data_sharding(mesh1))
@@ -63,7 +63,7 @@ def test_sharded_step_equals_replicated():
 
     # model-parallel layout on the 2-D (4, 2) mesh
     mesh2 = runtime.make_mesh(model_parallel=2)
-    state = engine.init_state(jax.random.PRNGKey(0), 1)
+    state = engine.init_state(jax.random.PRNGKey(0))
     sharding = parallel.state_sharding(state, mesh2)
     s_mp = jax.device_put(state, sharding)
     # at least one param tensor actually lives sharded over 'model'
@@ -106,7 +106,7 @@ def test_eval_step_with_sharded_params():
     engine = _engine()
     images, labels, valid = _batch()
     mesh2 = runtime.make_mesh(model_parallel=2)
-    state = engine.init_state(jax.random.PRNGKey(0), 1)
+    state = engine.init_state(jax.random.PRNGKey(0))
     s_mp = jax.device_put(state, parallel.state_sharding(state, mesh2))
     m = engine.eval_step(s_mp,
                          jax.device_put(images, runtime.data_sharding(mesh2)),

@@ -16,6 +16,10 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 from distributedpytorch_tpu.cli import main
 import sys
+import pytest
+
+# subprocess worlds / full CLI chains: the slow tier (scripts/gate.sh runs -m 'not slow')
+pytestmark = pytest.mark.slow
 sys.exit(main(["train", "-d", "/nodata", "--rsl_path", sys.argv[1],
                "--dataset", "synthetic", "--synthetic-fallback",
                "--model", "mlp", "-b", "8", "-e", "500", "--debug",

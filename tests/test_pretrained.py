@@ -119,7 +119,7 @@ def test_feature_extract_finetune_trains_head_only(tmp_path):
     engine = Engine(model, "resnet", get_loss_fn("cross_entropy"), tx,
                     mean=0.45, std=0.2, input_size=size,
                     half_precision=False)
-    state = engine.init_state(jax.random.PRNGKey(0), 1)
+    state = engine.init_state(jax.random.PRNGKey(0))
     params, stats = pretrained.load_pretrained(
         "resnet", str(path), state.params, state.batch_stats)
     state = state.replace(params=params, batch_stats=stats)

@@ -30,7 +30,7 @@ def setup():
                     mean=0.5, std=0.25, input_size=28, half_precision=False)
 
     def make_state():  # fresh each call: train_epoch donates its input
-        return jax.device_put(engine.init_state(jax.random.PRNGKey(0), 1),
+        return jax.device_put(engine.init_state(jax.random.PRNGKey(0)),
                               runtime.replicated_sharding(mesh))
 
     return split, mesh, engine, make_state

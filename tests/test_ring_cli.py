@@ -13,6 +13,9 @@ from distributedpytorch_tpu.config import Config
 from distributedpytorch_tpu.models import get_model
 from distributedpytorch_tpu import runtime
 
+# subprocess worlds / full CLI chains: the slow tier (scripts/gate.sh runs -m 'not slow')
+pytestmark = pytest.mark.slow
+
 
 def _cfg(tmp_path, name, **kw):
     kw.setdefault("model_parallel", 2)

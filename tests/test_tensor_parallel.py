@@ -42,6 +42,7 @@ def _cfg(tmp_path, name, **kw):
                   half_precision=False, model_parallel=2, **kw)
 
 
+@pytest.mark.slow
 def test_tp_cli_trains_to_same_params(tmp_path):
     base = run_train(_cfg(tmp_path, "base"))
     tp = run_train(_cfg(tmp_path, "tp", tensor_parallel=True))

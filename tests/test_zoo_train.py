@@ -19,6 +19,9 @@ from distributedpytorch_tpu import models
 from distributedpytorch_tpu.ops.losses import get_loss_fn
 from distributedpytorch_tpu.train.engine import Engine, make_optimizer
 
+# subprocess worlds / full CLI chains: the slow tier (scripts/gate.sh runs -m 'not slow')
+pytestmark = pytest.mark.slow
+
 # Reduced sizes for CPU tractability; the real registry sizes (224/299,
 # ref utils.py:24-36) are covered by the shape suite in test_models.py.
 # Inception must run at native 299: its aux head needs a 17x17 feature map
@@ -43,7 +46,7 @@ def test_one_real_train_step(name):
     engine = Engine(model, name, get_loss_fn("cross_entropy"), tx,
                     mean=0.45, std=0.2, input_size=size,
                     half_precision=False)
-    state = engine.init_state(jax.random.PRNGKey(0), 1)
+    state = engine.init_state(jax.random.PRNGKey(0))
     before = _flat(state.params)
     aux_before = (_flat(state.params["AuxHead_0"])
                   if name in models.registry.AUX_LOGIT_MODELS else None)
