@@ -382,6 +382,8 @@ def run_train(cfg: Config) -> dict:
         # grad accumulation slices that by K again before the model
         # applies (engine.py stride-k microbatches).
         n_micro = cfg.pipeline_microbatches or cfg.model_parallel
+        # exact division: the grad-accum check above already enforced
+        # batch_size % grad_accum == 0 (so batch*mp is divisible too)
         b_local = cfg.batch_size * cfg.model_parallel // cfg.grad_accum
         if b_local < n_micro or b_local % n_micro:
             raise ValueError(
