@@ -73,6 +73,21 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     if _initialized:
         return
     if coordinator_address is not None or _multihost_env():
+        # env:// rendezvous parity (ref classif.py:86-87 reads MASTER_ADDR/
+        # MASTER_PORT + explicit world_size/rank): fill what the caller
+        # left None from the standard coordinator env vars, so a launcher
+        # that only exports env — the reference's whole contract — works
+        # argless.  On real TPU pods none of the *_NUM_PROCESSES/_PROCESS_ID
+        # vars are set and everything stays None, preserving
+        # jax.distributed.initialize()'s cluster auto-detection.
+        if coordinator_address is None:
+            coordinator_address = (
+                os.environ.get("JAX_COORDINATOR_ADDRESS")
+                or os.environ.get("COORDINATOR_ADDRESS"))
+        if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+            num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+        if process_id is None and "JAX_PROCESS_ID" in os.environ:
+            process_id = int(os.environ["JAX_PROCESS_ID"])
         # Cross-process collectives on the CPU backend need gloo (the
         # multi-process test path; TPU runs ignore this — platform
         # selection happens later and TPU collectives ride ICI/DCN).
