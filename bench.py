@@ -394,7 +394,12 @@ def run_attention_suite(args) -> dict:
     from distributedpytorch_tpu.ops import attention
     from distributedpytorch_tpu.ops.flash_attention import flash_attention
 
-    def measure(fn, shape, n=30):
+    def measure(fn, shape, n=200):
+        # n=200: the sync-mode fixed dispatch cost (~95-146 ms, see
+        # _force_sync_timing_mode) is ONE per timed call; at n=30 it
+        # added an identical ~5 ms/iter to both variants and compressed
+        # the reported speedup toward 1x.  At n=200 the residual is
+        # <0.8 ms/iter — small against every row.
         ks = jax.random.split(jax.random.PRNGKey(1), 3)
         q, k, v = (jax.random.normal(kk, shape, jnp.bfloat16) for kk in ks)
         grad = jax.grad(

@@ -35,7 +35,8 @@ def _build_engine(cfg: Config, model_name: str, dataset: Dataset,
                       attention=cfg.attention, mesh=mesh,
                       tensor_parallel=cfg.tensor_parallel,
                       pipeline_parallel=cfg.pipeline_parallel,
-                      pipeline_microbatches=cfg.pipeline_microbatches)
+                      pipeline_microbatches=cfg.pipeline_microbatches,
+                      moe_experts=cfg.moe_experts)
     # Working weighted/focal losses (fixes SURVEY defect #4).
     class_weights = (dataset.class_weights()
                      if cfg.loss in ("weighted_cross_entropy", "focal_loss")
@@ -372,6 +373,22 @@ def run_train(cfg: Config) -> dict:
         raise ValueError(
             "--pipeline-microbatches requires --pipeline-parallel "
             "(it sets the GPipe M)")
+    if cfg.moe_experts and (model_name != "vit" or cfg.tensor_parallel
+                            or cfg.pipeline_parallel
+                            or cfg.moe_experts < 2):
+        # the registry enforces this too; fail before the dataset load
+        raise ValueError(
+            "--moe-experts needs --model vit, E >= 2, and is exclusive "
+            "with --tensor-parallel/--pipeline-parallel; got "
+            f"model={model_name!r}, moe_experts={cfg.moe_experts}, "
+            f"tensor_parallel={cfg.tensor_parallel}, "
+            f"pipeline_parallel={cfg.pipeline_parallel}")
+    if (cfg.moe_experts and cfg.model_parallel >= 2
+            and cfg.moe_experts % cfg.model_parallel):
+        raise ValueError(
+            f"--moe-experts {cfg.moe_experts} must be divisible by "
+            f"--model-parallel {cfg.model_parallel} for expert "
+            "parallelism (each device holds E/mp experts)")
     if cfg.pipeline_parallel:
         # The pipeline must actually engage: the per-data-shard batch the
         # MODEL sees has to hold >= M microbatch rows, else it would

@@ -131,6 +131,11 @@ class Config:
     # (P-1)/(M+P-1) at the cost of smaller per-tick matmuls; the
     # per-device batch must be divisible by M.
     pipeline_microbatches: int = 0
+    # > 0 replaces the vit MLPs with switch mixture-of-experts layers of
+    # that many experts (models/moe.py) — expert-PARALLEL over the
+    # 'model' mesh axis when --model-parallel >= 2, replicated experts
+    # otherwise.  Exclusive with --tensor-parallel/--pipeline-parallel.
+    moe_experts: int = 0
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -223,6 +228,12 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                         "stage); larger M shrinks the pipeline bubble "
                         "(P-1)/(M+P-1); per-device batch must divide "
                         "by M")
+    p.add_argument("--moe-experts", type=int, default=0,
+                   dest="moeExperts", metavar="E",
+                   help="replace the vit MLPs with E-expert switch "
+                        "mixture-of-experts layers (expert-parallel "
+                        "over the 'model' axis when --model-parallel "
+                        ">= 2; default 0 = dense MLPs)")
     p.add_argument("--tensor-parallel", action="store_true",
                    dest="tensorParallel",
                    help="Megatron-style tensor parallelism for --model "
@@ -292,4 +303,5 @@ def config_from_argv(argv=None) -> Config:
         tensor_parallel=args.tensorParallel,
         pipeline_parallel=args.pipelineParallel,
         pipeline_microbatches=args.pipelineMicrobatches,
+        moe_experts=args.moeExperts,
     )

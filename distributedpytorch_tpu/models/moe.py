@@ -1,0 +1,144 @@
+"""Switch-style mixture-of-experts MLP — the expert-parallel (EP) leg of
+the framework's parallelism taxonomy.
+
+The reference has no MoE anywhere (SURVEY §2 parallelism checklist:
+"Expert parallel (EP/MoE): ABSENT"); this module is TPU-first framework
+capability completing the taxonomy (dp / ZeRO / TP / sequence-parallel
+ring / PP / EP) on the same 2-D (data, model) mesh.
+
+Design (the classic dense-dispatch TPU formulation — static shapes,
+every op an einsum the MXU can run; no gather/scatter, no ragged
+shapes):
+
+  * top-1 routing: a float32 router picks one expert per token, the
+    winning softmax probability scales the expert's output (so routing
+    receives gradient through the gate);
+  * fixed expert capacity C = ceil(tokens/E * capacity_factor): each
+    expert processes exactly C token slots; tokens beyond an expert's
+    capacity are DROPPED (contribute zero — the standard switch
+    trade that keeps shapes static for XLA);
+  * dispatch/combine are one-hot einsums: tokens (N, D) are scattered
+    into (E, C, D) expert batches and gathered back with gate weights,
+    all as matmuls;
+  * expert FFNs are E-batched matmuls on (E, C, D) x (E, D, H) — ONE
+    einsum for all experts;
+  * EXPERT PARALLELISM: sharding constraints (the injected
+    ``ep_constrain``, same mechanism as tensor parallelism's
+    parallel.make_tp_constrain) pin the leading E axis of the expert
+    batches to the mesh's 'model' axis — GSPMD then partitions the
+    expert matmuls so each device computes only its experts, and
+    inserts the dispatch/combine all-to-alls between the data-sharded
+    token axis and the expert-sharded batches.  Constraints never
+    change the math (tests pin sharded == replicated bitwise-close);
+  * the load-balancing auxiliary loss (Switch Transformer form:
+    E * sum_e f_e * P_e, with f_e the dispatched-token fraction and
+    P_e the mean router probability of expert e) is exposed through
+    flax's ``sow`` into the 'losses' collection; the train engine adds
+    every sown loss into the optimized scalar (train/engine.py).
+
+Numerics are pinned in tests/test_moe.py: the dispatch/combine path
+equals a direct per-token computation through the argmax expert
+(capacity permitting), dropped tokens contribute exactly zero, and the
+expert-sharded program equals the replicated one.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..runtime import MODEL_AXIS
+
+ConstrainFn = Callable[..., jnp.ndarray]  # (x, partition-spec tuple) -> x
+
+
+class SwitchMLP(nn.Module):
+    """Drop-in replacement for a transformer block's dense MLP."""
+
+    dim: int
+    hidden: int
+    num_experts: int
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.01
+    dtype: Any = jnp.bfloat16
+    ep_constrain: Optional[ConstrainFn] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b, s, d = x.shape
+        n_tok = b * s
+        e = self.num_experts
+        cap = max(1, math.ceil(n_tok / e * self.capacity_factor))
+        ep = self.ep_constrain or (lambda a, _spec: a)
+        tokens = x.reshape(n_tok, d)
+
+        # Router in float32: small, and routing decisions should not
+        # flap with bf16 rounding.
+        logits = nn.Dense(e, dtype=jnp.float32, name="router")(
+            tokens.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)            # (N, E)
+        expert = jnp.argmax(probs, axis=-1)                # (N,)
+        gate = jnp.max(probs, axis=-1)                     # (N,)
+
+        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # (N, E)
+        # position of each token within its expert's queue (1-based)
+        pos = jnp.cumsum(onehot, axis=0) * onehot
+        keep = (pos > 0) & (pos <= cap)
+        slot = jnp.clip(pos - 1, 0, cap - 1).astype(jnp.int32)
+        # (N, E, C) one-hot dispatch mask; combine adds the gate weight
+        disp = (jax.nn.one_hot(jnp.sum(slot, axis=-1), cap,
+                               dtype=jnp.float32)[:, None, :]
+                * (onehot * keep)[:, :, None])
+        combine = disp * gate[:, None, None]
+
+        if train and self.aux_loss_coef > 0:
+            # Switch load-balancing loss: E * sum_e f_e * P_e — minimized
+            # (= 1) by a uniform dispatch; keeps top-1 routing from
+            # collapsing onto few experts.  Computed over ALL tokens,
+            # including rows the engine's valid-mask excludes from the
+            # CE loss: this framework's sampler pads batches by
+            # WRAPAROUND-DUPLICATING real samples (data/sampler.py,
+            # torch DistributedSampler parity), so those rows carry the
+            # real input distribution and only overweight duplicates
+            # slightly — not garbage.  Threading the valid mask down
+            # here would shave that residual bias at the cost of a
+            # model-signature change; documented trade, not taken.
+            # f_e is the PRE-capacity routing fraction (the Switch
+            # formula): capping it at capacity/N would weaken the
+            # anti-collapse gradient exactly when an expert overloads.
+            f = jnp.mean(onehot, axis=0)                   # (E,)
+            p = jnp.mean(probs, axis=0)                    # (E,)
+            self.sow("losses", "moe_load_balance",
+                     self.aux_loss_coef * e * jnp.sum(f * p))
+
+        cdt = self.dtype
+        # dispatch: (N,E,C) x (N,D) -> (E,C,D), the first all-to-all
+        # point under EP (tokens data-sharded -> expert-sharded)
+        expert_in = jnp.einsum("nec,nd->ecd", disp.astype(cdt),
+                               tokens.astype(cdt))
+        expert_in = ep(expert_in, (MODEL_AXIS, None, None))
+
+        init = nn.initializers.lecun_normal(batch_axis=0)
+        w_up = self.param("w_up", init, (e, d, self.hidden), jnp.float32)
+        b_up = self.param("b_up", nn.initializers.zeros,
+                          (e, self.hidden), jnp.float32)
+        w_down = self.param("w_down", init, (e, self.hidden, d),
+                            jnp.float32)
+        b_down = self.param("b_down", nn.initializers.zeros, (e, d),
+                            jnp.float32)
+
+        h = jnp.einsum("ecd,edh->ech", expert_in, w_up.astype(cdt))
+        h = nn.gelu(h + b_up.astype(cdt)[:, None, :])
+        h = ep(h, (MODEL_AXIS, None, None))
+        out = jnp.einsum("ech,ehd->ecd", h, w_down.astype(cdt))
+        out = out + b_down.astype(cdt)[:, None, :]
+        out = ep(out, (MODEL_AXIS, None, None))
+
+        # combine: (N,E,C) x (E,C,D) -> (N,D), the second all-to-all;
+        # dropped tokens have an all-zero combine row -> exactly zero
+        y = jnp.einsum("nec,ecd->nd", combine.astype(cdt), out)
+        return y.reshape(b, s, d)

@@ -64,7 +64,8 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
               attention: str = "full", mesh=None,
               tensor_parallel: bool = False,
               pipeline_parallel: bool = False,
-              pipeline_microbatches: int = 0) -> nn.Module:
+              pipeline_microbatches: int = 0,
+              moe_experts: int = 0) -> nn.Module:
     """``attention``: 'full' (default, XLA-fused softmax attention),
     'ring' (sequence-parallel over ``mesh``'s 'model' axis via
     lax.ppermute — ops/attention.py), 'flash' (the Pallas kernel,
@@ -83,6 +84,22 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
         raise ValueError(f"attention must be 'full', 'ring', 'flash' or "
                          f"'ring_flash', got {attention!r}")
     dtype = jnp.bfloat16 if half_precision else jnp.float32
+    if moe_experts:
+        if name != "vit":
+            raise ValueError(
+                "--moe-experts applies to the attention model family "
+                f"only (--model vit); {name!r} has no MLP blocks to "
+                "replace")
+        if moe_experts < 2:
+            raise ValueError(
+                f"--moe-experts must be >= 2, got {moe_experts}")
+        if tensor_parallel or pipeline_parallel:
+            raise ValueError(
+                "--moe-experts is exclusive with --tensor-parallel "
+                "(both shard the MLP over 'model') and "
+                "--pipeline-parallel (the pipelined vit hand-rolls "
+                "dense blocks); it composes with --attention "
+                "full/ring/flash")
     if pipeline_parallel:
         if name != "vit":
             raise ValueError(
@@ -108,7 +125,7 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
                                          depth, heads,
                                          n_micro=pipeline_microbatches
                                          or None))
-    if attention != "full" or tensor_parallel:
+    if attention != "full" or tensor_parallel or moe_experts:
         if name != "vit":
             feature = (f"--attention {attention}" if attention != "full"
                        else "--tensor-parallel")
@@ -144,6 +161,31 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
             return ViT(num_classes=num_classes, dtype=dtype,
                        attention_fn=attn_fn,
                        tp_constrain=make_tp_constrain(mesh))
+        if moe_experts:
+            # Expert parallelism when a model axis exists (>= 2 devices
+            # on 'model'): the expert batches' leading E axis is pinned
+            # there (models/moe.py).  Without one, MoE still runs —
+            # experts replicated — so single-device training/eval works.
+            from ..runtime import MODEL_AXIS
+
+            moe_constrain = None
+            if mesh is not None and MODEL_AXIS in mesh.shape \
+                    and mesh.shape[MODEL_AXIS] >= 2:
+                from ..parallel import make_tp_constrain
+
+                mp = mesh.shape[MODEL_AXIS]
+                if moe_experts % mp:
+                    # the constrain helper silently skips non-divisible
+                    # axes, which would leave every expert replicated —
+                    # the user asked for EP, so refuse loudly instead
+                    raise ValueError(
+                        f"--moe-experts {moe_experts} must be divisible "
+                        f"by --model-parallel {mp} for expert "
+                        "parallelism (each device holds E/mp experts)")
+                moe_constrain = make_tp_constrain(mesh)
+            return ViT(num_classes=num_classes, dtype=dtype,
+                       attention_fn=attn_fn, moe_experts=moe_experts,
+                       moe_constrain=moe_constrain)
         return ViT(num_classes=num_classes, dtype=dtype,
                    attention_fn=attn_fn)
     return MODEL_REGISTRY[name](num_classes, dtype)

@@ -45,6 +45,13 @@ class TransformerBlock(nn.Module):
     dtype: Any
     attention_fn: AttentionFn
     tp_constrain: Optional[ConstrainFn] = None
+    # > 0 replaces the dense MLP with a switch mixture-of-experts of that
+    # many experts (models/moe.py) — the expert-parallel family member.
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    # sharding-constraint fn for the expert axis (expert parallelism);
+    # separate from tp_constrain so EP does not imply head/hidden TP
+    moe_constrain: Optional[ConstrainFn] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -69,12 +76,24 @@ class TransformerBlock(nn.Module):
         x = tp(x, (DATA_AXIS, None, None))
 
         h = nn.LayerNorm(dtype=self.dtype)(x)
-        h = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype,
-                     name="mlp_up")(h)
-        # MLP hidden on MODEL_AXIS: column-parallel up, row-parallel down.
-        h = tp(nn.gelu(h), (DATA_AXIS, None, MODEL_AXIS))
-        h = nn.Dense(self.dim, dtype=self.dtype, name="mlp_down")(h)
-        x = x + h
+        if self.moe_experts > 0:
+            from .moe import SwitchMLP
+
+            h = SwitchMLP(dim=self.dim,
+                          hidden=self.mlp_ratio * self.dim,
+                          num_experts=self.moe_experts,
+                          capacity_factor=self.moe_capacity_factor,
+                          dtype=self.dtype, ep_constrain=self.moe_constrain,
+                          name="moe")(h, train=train)
+            x = x + h
+        else:
+            h = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype,
+                         name="mlp_up")(h)
+            # MLP hidden on MODEL_AXIS: column-parallel up, row-parallel
+            # down.
+            h = tp(nn.gelu(h), (DATA_AXIS, None, MODEL_AXIS))
+            h = nn.Dense(self.dim, dtype=self.dtype, name="mlp_down")(h)
+            x = x + h
         return tp(x, (DATA_AXIS, None, None))
 
 
@@ -91,6 +110,9 @@ class ViT(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[AttentionFn] = None
     tp_constrain: Optional[ConstrainFn] = None
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_constrain: Optional[ConstrainFn] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -107,6 +129,9 @@ class ViT(nn.Module):
         for i in range(self.depth):
             x = TransformerBlock(self.dim, self.heads, self.mlp_ratio,
                                  self.dtype, attn_fn, self.tp_constrain,
+                                 moe_experts=self.moe_experts,
+                                 moe_capacity_factor=self.moe_capacity_factor,
+                                 moe_constrain=self.moe_constrain,
                                  name=f"block{i}")(x, train=train)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         x = jnp.mean(x, axis=1)  # mean-pool tokens

@@ -135,19 +135,28 @@ class Engine:
 
     def _apply(self, params, batch_stats, imgs, train: bool,
                dropout_key: Optional[jax.Array]):
+        """Returns (out, new_batch_stats, aux_loss): ``aux_loss`` is the
+        sum of everything the model sowed into the 'losses' collection
+        in train mode (e.g. the MoE load-balancing loss,
+        models/moe.py) — 0.0 for models that sow nothing."""
         variables = {"params": params}
         has_bn = len(jax.tree_util.tree_leaves(batch_stats)) > 0
         if has_bn:
             variables["batch_stats"] = batch_stats
         rngs = ({"dropout": dropout_key}
                 if (train and self.uses_dropout) else None)
-        if train and has_bn:
+        if train:
             out, updated = self.model.apply(
                 variables, imgs, train=True, rngs=rngs,
-                mutable=["batch_stats"])
-            return out, updated["batch_stats"]
+                mutable=["batch_stats", "losses"])
+            aux = sum(
+                (jnp.sum(leaf) for leaf in
+                 jax.tree_util.tree_leaves(updated.get("losses", {}))),
+                jnp.zeros((), jnp.float32))
+            new_bs = updated.get("batch_stats", batch_stats)
+            return out, new_bs, aux
         out = self.model.apply(variables, imgs, train=train, rngs=rngs)
-        return out, batch_stats
+        return out, batch_stats, jnp.zeros((), jnp.float32)
 
     def _reduce_loss(self, logits, labels, vmask):
         numer, denom = self.loss_fn(logits, labels)
@@ -181,8 +190,8 @@ class Engine:
                                           dropout_key)
 
         def compute_loss(params):
-            out, new_bs = self._apply(params, state.batch_stats, imgs,
-                                      True, dropout_key)
+            out, new_bs, sown = self._apply(params, state.batch_stats,
+                                            imgs, True, dropout_key)
             if self.has_aux:
                 logits, aux_logits = out
                 loss = (self._reduce_loss(logits, labels, vmask)
@@ -190,7 +199,7 @@ class Engine:
             else:
                 logits = out
                 loss = self._reduce_loss(logits, labels, vmask)
-            return loss, (logits, new_bs)
+            return loss + sown, (logits, new_bs)
 
         (loss, (logits, new_bs)), grads = jax.value_and_grad(
             compute_loss, has_aux=True)(state.params)
@@ -259,7 +268,8 @@ class Engine:
         imgs_m, labels_m, vmask_m = shard(imgs), shard(labels), shard(vmask)
 
         def numer_fn(params, batch_stats, im, lb, vm, dkey):
-            out, new_bs = self._apply(params, batch_stats, im, True, dkey)
+            out, new_bs, sown = self._apply(params, batch_stats, im, True,
+                                            dkey)
             if self.has_aux:
                 logits, aux_logits = out
                 n_main, d = self.loss_fn(logits, lb)
@@ -269,6 +279,12 @@ class Engine:
                 logits = out
                 n_main, d = self.loss_fn(logits, lb)
                 numer = jnp.sum(n_main * vm)
+            # sown aux losses (e.g. MoE load balance) are computed per
+            # MICROBATCH; weighting by this microbatch's denominator
+            # makes the accumulated loss the denominator-weighted mean
+            # of the per-microbatch aux values (documented divergence
+            # from the K=1 step, which computes aux on the full batch).
+            numer = numer + sown * jnp.sum(d * vm)
             correct = jnp.sum(per_example_correct(logits, lb) * vm)
             return numer, (new_bs, jnp.sum(d * vm), correct)
 
@@ -445,7 +461,7 @@ class Engine:
                                       self.input_size,
                                       out_dtype=self.compute_dtype)
         vmask = valid.astype(jnp.float32)
-        out, _ = self._apply(state.params, state.batch_stats, imgs,
+        out, _, _ = self._apply(state.params, state.batch_stats, imgs,
                              False, None)
         logits = out[0] if isinstance(out, tuple) else out
         numer, denom = self.loss_fn(logits, labels)

@@ -125,9 +125,12 @@ def main() -> int:
         lb = jnp.take(labels_all, ids, axis=0)
         aug = augment.train_transform(key, im, mean, std, out_dim,
                                       out_dtype=cdt)
-        out, _ = engine._apply(params, state.batch_stats, aug, True, key)
+        out, _, _ = engine._apply(params, state.batch_stats, aug, True, key)
+        # aux-logit models (inception) return (logits, aux_logits) in
+        # train mode; the ladder profiles the main head only
+        logits = out[0] if isinstance(out, tuple) else out
         vmask = v.astype(jnp.float32)
-        return engine._reduce_loss(out, lb, vmask)
+        return engine._reduce_loss(logits, lb, vmask)
 
     def stage_forward(acc, xs):
         ids, v = xs
