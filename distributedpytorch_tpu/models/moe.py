@@ -13,15 +13,21 @@ shapes):
   * top-1 routing: a float32 router picks one expert per token, the
     winning softmax probability scales the expert's output (so routing
     receives gradient through the gate);
-  * fixed expert capacity C = ceil(tokens/E * capacity_factor): each
-    expert processes exactly C token slots; tokens beyond an expert's
-    capacity are DROPPED (contribute zero — the standard switch
-    trade that keeps shapes static for XLA);
-  * dispatch/combine are one-hot einsums: tokens (N, D) are scattered
-    into (E, C, D) expert batches and gathered back with gate weights,
-    all as matmuls;
-  * expert FFNs are E-batched matmuls on (E, C, D) x (E, D, H) — ONE
-    einsum for all experts;
+  * tokens are split into GROUPS of whole batch rows (~GROUP_TOKENS
+    tokens per group) and capacity is per group:
+    C = ceil(group_tokens/E * capacity_factor).  Dispatch/combine cost
+    is then N*E*C ~ cf * N * group_tokens — LINEAR in total tokens,
+    not the cf*N^2 a single global capacity gives (the round-4
+    advisor's medium finding; this is the standard TPU switch
+    formulation, cf. Switch Transformer's per-group expert capacity).
+    Tokens beyond an expert's per-group capacity are DROPPED
+    (contribute zero — the standard switch trade that keeps shapes
+    static for XLA);
+  * dispatch/combine are one-hot einsums: grouped tokens (G, N_g, D)
+    are scattered into (G, E, C, D) expert batches and gathered back
+    with gate weights, all as matmuls;
+  * expert FFNs are (G, E)-batched matmuls on (G, E, C, D) x (E, D, H)
+    — ONE einsum for all experts;
   * EXPERT PARALLELISM: sharding constraints (the injected
     ``ep_constrain``, same mechanism as tensor parallelism's
     parallel.make_tp_constrain) pin the leading E axis of the expert
@@ -55,6 +61,20 @@ from ..runtime import MODEL_AXIS
 
 ConstrainFn = Callable[..., jnp.ndarray]  # (x, partition-spec tuple) -> x
 
+# Target tokens per dispatch group.  Capacity (and so dispatch-mask
+# width) is computed per group, keeping the (G, N_g, E, C) dispatch
+# tensor ~ cf * N * GROUP_TOKENS elements — linear in total tokens.
+# Groups are whole batch rows so they follow the batch's data sharding.
+GROUP_TOKENS = 1024
+
+
+def _rows_per_group(b: int, s: int) -> int:
+    """Largest divisor of ``b`` whose group holds <= ~GROUP_TOKENS
+    tokens (at least one row; static Python, shapes are static)."""
+    from ..utils import largest_divisor_leq
+
+    return largest_divisor_leq(b, max(1, GROUP_TOKENS // max(1, s)))
+
 
 class SwitchMLP(nn.Module):
     """Drop-in replacement for a transformer block's dense MLP."""
@@ -72,7 +92,9 @@ class SwitchMLP(nn.Module):
         b, s, d = x.shape
         n_tok = b * s
         e = self.num_experts
-        cap = max(1, math.ceil(n_tok / e * self.capacity_factor))
+        rows = _rows_per_group(b, s)
+        g, n_g = b // rows, rows * s
+        cap = max(1, math.ceil(n_g / e * self.capacity_factor))
         ep = self.ep_constrain or (lambda a, _spec: a)
         tokens = x.reshape(n_tok, d)
 
@@ -84,16 +106,18 @@ class SwitchMLP(nn.Module):
         expert = jnp.argmax(probs, axis=-1)                # (N,)
         gate = jnp.max(probs, axis=-1)                     # (N,)
 
-        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # (N, E)
-        # position of each token within its expert's queue (1-based)
-        pos = jnp.cumsum(onehot, axis=0) * onehot
+        onehot = jax.nn.one_hot(expert, e,
+                                dtype=jnp.float32).reshape(g, n_g, e)
+        # position of each token within its expert's PER-GROUP queue
+        # (1-based); capacity applies within the group
+        pos = jnp.cumsum(onehot, axis=1) * onehot
         keep = (pos > 0) & (pos <= cap)
         slot = jnp.clip(pos - 1, 0, cap - 1).astype(jnp.int32)
-        # (N, E, C) one-hot dispatch mask; combine adds the gate weight
+        # (G, N_g, E, C) one-hot dispatch mask; combine adds the gate
         disp = (jax.nn.one_hot(jnp.sum(slot, axis=-1), cap,
-                               dtype=jnp.float32)[:, None, :]
-                * (onehot * keep)[:, :, None])
-        combine = disp * gate[:, None, None]
+                               dtype=jnp.float32)[:, :, None, :]
+                * (onehot * keep)[:, :, :, None])
+        combine = disp * gate.reshape(g, n_g)[:, :, None, None]
 
         if train and self.aux_loss_coef > 0:
             # Switch load-balancing loss: E * sum_e f_e * P_e — minimized
@@ -110,17 +134,21 @@ class SwitchMLP(nn.Module):
             # f_e is the PRE-capacity routing fraction (the Switch
             # formula): capping it at capacity/N would weaken the
             # anti-collapse gradient exactly when an expert overloads.
-            f = jnp.mean(onehot, axis=0)                   # (E,)
+            f = jnp.mean(onehot, axis=(0, 1))              # (E,)
             p = jnp.mean(probs, axis=0)                    # (E,)
             self.sow("losses", "moe_load_balance",
                      self.aux_loss_coef * e * jnp.sum(f * p))
 
         cdt = self.dtype
-        # dispatch: (N,E,C) x (N,D) -> (E,C,D), the first all-to-all
-        # point under EP (tokens data-sharded -> expert-sharded)
-        expert_in = jnp.einsum("nec,nd->ecd", disp.astype(cdt),
-                               tokens.astype(cdt))
-        expert_in = ep(expert_in, (MODEL_AXIS, None, None))
+        # dispatch: (G,N_g,E,C) x (G,N_g,D) -> (G,E,C,D), the first
+        # all-to-all point under EP (tokens data-sharded -> expert-
+        # sharded).  The group axis is left unconstrained: it inherits
+        # the batch's data sharding by propagation, and pinning only E
+        # to 'model' is what makes each device compute its experts.
+        grouped = tokens.reshape(g, n_g, d).astype(cdt)
+        expert_in = jnp.einsum("gnec,gnd->gecd", disp.astype(cdt),
+                               grouped)
+        expert_in = ep(expert_in, (None, MODEL_AXIS, None, None))
 
         init = nn.initializers.lecun_normal(batch_axis=0)
         w_up = self.param("w_up", init, (e, d, self.hidden), jnp.float32)
@@ -131,14 +159,15 @@ class SwitchMLP(nn.Module):
         b_down = self.param("b_down", nn.initializers.zeros, (e, d),
                             jnp.float32)
 
-        h = jnp.einsum("ecd,edh->ech", expert_in, w_up.astype(cdt))
-        h = nn.gelu(h + b_up.astype(cdt)[:, None, :])
-        h = ep(h, (MODEL_AXIS, None, None))
-        out = jnp.einsum("ech,ehd->ecd", h, w_down.astype(cdt))
-        out = out + b_down.astype(cdt)[:, None, :]
-        out = ep(out, (MODEL_AXIS, None, None))
+        h = jnp.einsum("gecd,edh->gech", expert_in, w_up.astype(cdt))
+        h = nn.gelu(h + b_up.astype(cdt)[None, :, None, :])
+        h = ep(h, (None, MODEL_AXIS, None, None))
+        out = jnp.einsum("gech,ehd->gecd", h, w_down.astype(cdt))
+        out = out + b_down.astype(cdt)[None, :, None, :]
+        out = ep(out, (None, MODEL_AXIS, None, None))
 
-        # combine: (N,E,C) x (E,C,D) -> (N,D), the second all-to-all;
-        # dropped tokens have an all-zero combine row -> exactly zero
-        y = jnp.einsum("nec,ecd->nd", combine.astype(cdt), out)
+        # combine: (G,N_g,E,C) x (G,E,C,D) -> (G,N_g,D), the second
+        # all-to-all; dropped tokens have an all-zero combine row ->
+        # exactly zero
+        y = jnp.einsum("gnec,gecd->gnd", combine.astype(cdt), out)
         return y.reshape(b, s, d)

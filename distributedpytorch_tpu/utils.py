@@ -144,6 +144,16 @@ def tree_size_bytes(tree) -> int:
                for x in jax.tree_util.tree_leaves(tree))
 
 
+def largest_divisor_leq(n: int, limit: int) -> int:
+    """Largest divisor of ``n`` that is <= ``limit`` (at least 1).
+    Static-shape chunk sizing: MoE dispatch groups (models/moe.py) and
+    the conv-dW VMEM batch chunk (ops/conv.py)."""
+    d = max(1, min(n, limit))
+    while n % d:
+        d -= 1
+    return d
+
+
 def print_network_info(params) -> None:
     """Param inventory (ref: printNetworkInfo, utils.py:164-166 — fixed:
     the reference passes multiple args to logging.info and crashes)."""

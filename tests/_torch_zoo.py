@@ -1,4 +1,4 @@
-"""Plain-torch re-implementations of the three torchvision architectures
+"""Plain-torch re-implementations of the six torchvision architectures
 the pretrained converter supports, with torchvision's exact state_dict key
 names (torchvision itself is not in this image).  Test harness only: used
 to produce state_dicts in the torchvision wire format and reference logits
