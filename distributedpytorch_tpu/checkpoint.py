@@ -175,20 +175,61 @@ def _save_orbax(path: str, model_name: str, state: TrainState,
         shutil.rmtree(tmp)
     runtime.barrier()  # nobody saves into .tmp until the cleanup is done
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.join(tmp, "state"),
-               serialization.to_state_dict(state))
+    state_sd = serialization.to_state_dict(state)
+    ckptr.save(os.path.join(tmp, "state"), state_sd)
     ckptr.wait_until_finished()
     runtime.barrier()  # every host's shards are on disk before the swap
     if jax.process_index() == 0:
         with open(os.path.join(tmp, _ORBAX_META), "w") as f:
+            # params_layout ('stacked' | 'blocks' | null) lets the loader
+            # restore a pipeline-trained directory into a plain model
+            # (and vice versa) without guessing the on-disk tree shape.
             json.dump({"format_version": _FORMAT_VERSION,
                        "model_name": model_name, "epoch": int(epoch),
-                       "loss": float(best_valid_loss)}, f)
+                       "loss": float(best_valid_loss),
+                       "params_layout": vit_pipeline.params_layout(
+                           state_sd.get("params")),
+                       # lets the loader refuse a cross-layout restore
+                       # into/out of a MoE tree with a clear message
+                       # instead of an opaque structure mismatch
+                       "moe": _has_moe_blocks(state_sd.get("params"))}, f)
         if os.path.exists(path):
             shutil.rmtree(path)
         os.replace(tmp, path)
         logging.info(f"epoch:{epoch:04d}: model saved to {path}")
     runtime.barrier()  # no host proceeds until the swap is visible
+
+
+def _has_moe_blocks(params) -> bool:
+    """True when a params(-shaped) dict holds mixture-of-experts blocks
+    (block0/moe) — those cannot round-trip through the stacked<->blocks
+    dense-MLP conversion."""
+    if not isinstance(params, dict):
+        return False
+    blk = params.get("block0")
+    return isinstance(blk, dict) and "moe" in blk
+
+
+def _check_layouts_convertible(path: str, src: str, dst: str,
+                               template_params, saved_params=None,
+                               saved_is_moe: bool = False) -> None:
+    """A stacked<->blocks conversion is about to run: refuse with a clear
+    message when either side holds MoE blocks (the conversion would
+    fabricate dense mlp_up/mlp_down entries that cannot match a MoE
+    template, surfacing as an opaque structure mismatch otherwise).
+    The orbax path can't read the saved tree cheaply — it passes the
+    meta.json ``moe`` flag as ``saved_is_moe`` instead."""
+    ckpt_moe = saved_is_moe or _has_moe_blocks(saved_params)
+    if ckpt_moe or _has_moe_blocks(template_params):
+        side = ("the checkpoint holds" if ckpt_moe
+                else "the requested model uses")
+        raise ValueError(
+            f"checkpoint at {path} has {src!r}-layout transformer "
+            f"params, the requested model the {dst!r} layout, and "
+            f"{side} mixture-of-experts blocks; stacked<->blocks "
+            "conversion only covers dense MLPs (MoE is not a "
+            "pipeline stage architecture) — load with a matching "
+            "--moe-experts / --pipeline-parallel configuration")
 
 
 def _load_orbax(path: str, state: TrainState, restore_optimizer: bool
@@ -230,8 +271,24 @@ def _load_orbax(path: str, state: TrainState, restore_optimizer: bool
         Mesh(np.asarray(jax.devices()).reshape(-1), ("_all",)),
         PartitionSpec())
 
+    # Cross-layout restore (self-describing-checkpoint parity, ref
+    # classif.py:214, same contract the msgpack path has): when the
+    # directory was saved with the other vit block layout (meta
+    # params_layout, absent in old checkpoints -> no conversion), build
+    # the restore target in the SAVED layout — convert_layout works at
+    # shape level on ShapeDtypeStruct trees — then convert the restored
+    # arrays to the template's layout.  Converted leaves change shape,
+    # so the whole target restores replicated (the plain-model
+    # ``test -f`` case is replicated anyway).
+    src = meta.get("params_layout")
+    dst = vit_pipeline.params_layout(template.get("params"))
+    convert = src in ("stacked", "blocks") and dst is not None \
+        and src != dst
+
     def leaf_target(x):
         s = getattr(x, "sharding", None)
+        if convert:
+            return replicated
         if isinstance(s, NamedSharding) and len(s.device_set) == n_devices:
             return s  # placed on the global mesh: restore as-laid-out
         return replicated
@@ -241,6 +298,12 @@ def _load_orbax(path: str, state: TrainState, restore_optimizer: bool
             tuple(np.shape(x)), getattr(x, "dtype", np.asarray(x).dtype),
             sharding=leaf_target(x)),
         template)
+    if convert:
+        _check_layouts_convertible(path, src, dst, template.get("params"),
+                                   saved_is_moe=bool(meta.get("moe")))
+        abstract = vit_pipeline.convert_layout(abstract, src)
+        logging.info(f"checkpoint params will be converted: {src} -> "
+                     f"{dst} block layout")
     try:
         if restore_optimizer:
             restored_dict = ocp.StandardCheckpointer().restore(
@@ -263,6 +326,8 @@ def _load_orbax(path: str, state: TrainState, restore_optimizer: bool
     except Exception as e:
         raise ValueError(f"cannot restore orbax checkpoint {path!r}: "
                          f"{e}") from e
+    if convert:
+        restored_dict = vit_pipeline.convert_layout(restored_dict, dst)
     if not restore_optimizer:
         restored_dict["opt_state"] = template.get("opt_state", {})
     restored = serialization.from_state_dict(state, restored_dict)
@@ -314,12 +379,15 @@ def load_checkpoint(path: str, state: TrainState,
     # moments that mirror them — so `test -f` (and resume) work on a
     # pipeline-trained checkpoint without a pipeline mesh, and vice
     # versa (self-describing-checkpoint parity, ref classif.py:214).
-    # msgpack (the reference-contract format) only: orbax restores into
-    # the template's own abstract tree as-laid-out, so a pipeline-trained
-    # ORBAX directory needs --pipeline-parallel (+ mesh) to load.
+    # The orbax path does the same via meta.json's params_layout
+    # (_load_orbax converts the abstract restore target, then the
+    # restored arrays).
     src = vit_pipeline.params_layout(payload["state"].get("params"))
     dst = vit_pipeline.params_layout(template_sd.get("params"))
     if src is not None and dst is not None and src != dst:
+        _check_layouts_convertible(path, src, dst,
+                                   template_sd.get("params"),
+                                   payload["state"].get("params"))
         payload["state"] = vit_pipeline.convert_layout(payload["state"],
                                                        dst)
         logging.info(f"checkpoint params converted: {src} -> {dst} "
