@@ -314,7 +314,8 @@ def run_train(cfg: Config) -> dict:
     runtime.initialize_distributed()
     utils.initialize_logging(cfg.rsl_path, cfg.log_file,
                              truncate=runtime.is_main())
-    mesh = runtime.make_mesh(model_parallel=cfg.model_parallel)
+    mesh = runtime.make_mesh(model_parallel=cfg.model_parallel,
+                             seq_parallel=cfg.seq_parallel)
     world = runtime.world_size()
     if runtime.is_main():
         logging.info(f"process: {runtime.process_index()}/"
@@ -351,8 +352,12 @@ def run_train(cfg: Config) -> dict:
             f"size ({cfg.batch_size}); got {cfg.grad_accum}")
     vit_features = (cfg.attention != "full" or cfg.tensor_parallel
                     or cfg.pipeline_parallel)
+    # ring x pipeline is the one SUPPORTED composition (3-D mesh,
+    # --seq-parallel >= 2; vit_pipeline.make_pipeline_fn(ring=True))
+    ring_pp = (cfg.pipeline_parallel and cfg.attention == "ring"
+               and cfg.seq_parallel >= 2)
     exclusive = sum((cfg.attention != "full", cfg.tensor_parallel,
-                     cfg.pipeline_parallel)) > 1
+                     cfg.pipeline_parallel)) > 1 and not ring_pp
     needs_axis = (cfg.attention in ("ring", "ring_flash")
                   or cfg.tensor_parallel or cfg.pipeline_parallel)
     if vit_features and (model_name != "vit" or exclusive
@@ -362,12 +367,22 @@ def run_train(cfg: Config) -> dict:
         raise ValueError(
             "--attention ring/flash/ring_flash, --tensor-parallel and "
             "--pipeline-parallel require --model vit, are mutually "
-            "exclusive, and (except single-chip flash) need "
-            "--model-parallel >= 2; "
+            "exclusive (except --pipeline-parallel + --attention ring "
+            "with --seq-parallel >= 2), and (except single-chip flash) "
+            "need --model-parallel >= 2; "
             f"got model={model_name!r}, "
             f"model_parallel={cfg.model_parallel}, "
             f"attention={cfg.attention!r}, "
             f"tensor_parallel={cfg.tensor_parallel}, "
+            f"pipeline_parallel={cfg.pipeline_parallel}")
+    if cfg.seq_parallel > 1 and not ring_pp:
+        raise ValueError(
+            "--seq-parallel >= 2 is the ring x pipeline composition's "
+            "third mesh axis: it requires --pipeline-parallel with "
+            "--attention ring (for plain sequence parallelism use "
+            "--attention ring, which rings over the 'model' axis); got "
+            f"seq_parallel={cfg.seq_parallel}, "
+            f"attention={cfg.attention!r}, "
             f"pipeline_parallel={cfg.pipeline_parallel}")
     if cfg.pipeline_microbatches and not cfg.pipeline_parallel:
         raise ValueError(
@@ -401,7 +416,10 @@ def run_train(cfg: Config) -> dict:
         n_micro = cfg.pipeline_microbatches or cfg.model_parallel
         # exact division: the grad-accum check above already enforced
         # batch_size % grad_accum == 0 (so batch*mp is divisible too)
-        b_local = cfg.batch_size * cfg.model_parallel // cfg.grad_accum
+        # dp = world / (mp * sp), so each data shard sees
+        # batch * mp * sp rows (sp = 1 on the 2-D mesh)
+        b_local = (cfg.batch_size * cfg.model_parallel
+                   * cfg.seq_parallel // cfg.grad_accum)
         if b_local < n_micro or b_local % n_micro:
             raise ValueError(
                 f"--pipeline-parallel needs the per-data-shard batch "
@@ -581,7 +599,8 @@ def run_test(cfg: Config) -> dict:
     runtime.initialize_distributed()
     utils.initialize_logging(cfg.rsl_path, cfg.log_file,
                              truncate=runtime.is_main())
-    mesh = runtime.make_mesh(model_parallel=cfg.model_parallel)
+    mesh = runtime.make_mesh(model_parallel=cfg.model_parallel,
+                             seq_parallel=cfg.seq_parallel)
     if runtime.is_main():
         logging.info(f"process: {runtime.process_index()}/"
                      f"{runtime.process_count()}, world size: "

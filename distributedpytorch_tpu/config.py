@@ -108,6 +108,13 @@ class Config:
     # param/optimizer tensors over the 'model' axis (ZeRO/FSDP-style,
     # see parallel.py).  1 = pure data parallelism (reference semantics).
     model_parallel: int = 1
+    # Third mesh axis for the ring x pipeline composition: tokens
+    # sharded over an N-way 'seq' axis with ring attention inside each
+    # pipeline stage (vit_pipeline.make_pipeline_fn(ring=True)).
+    # 1 = no seq axis (2-D mesh).  Requires --pipeline-parallel +
+    # --attention ring; data_parallel becomes
+    # world / (model_parallel * seq_parallel).
+    seq_parallel: int = 1
     # 'full': XLA softmax attention on each device (default);
     # 'ring': sequence-parallel ring attention over the 'model' mesh axis
     # (vit only, needs model_parallel >= 2 — see ops/attention.py);
@@ -211,6 +218,11 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                    help="shard large param/optimizer tensors over an "
                         "N-way 'model' mesh axis (must divide the device "
                         "count; default 1 = replicated)")
+    p.add_argument("--seq-parallel", type=int, default=1,
+                   dest="seqParallel", metavar="N",
+                   help="N-way 'seq' mesh axis for --pipeline-parallel "
+                        "+ --attention ring (ring attention inside each "
+                        "pipeline stage; default 1 = 2-D mesh)")
     p.add_argument("--attention",
                    choices=("full", "ring", "flash", "ring_flash"),
                    default="full",
@@ -299,6 +311,7 @@ def config_from_argv(argv=None) -> Config:
         grad_accum=args.gradAccum,
         ckpt_format=args.ckptFormat,
         model_parallel=args.modelParallel,
+        seq_parallel=args.seqParallel,
         attention=args.attention,
         tensor_parallel=args.tensorParallel,
         pipeline_parallel=args.pipelineParallel,

@@ -65,7 +65,7 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
               tensor_parallel: bool = False,
               pipeline_parallel: bool = False,
               pipeline_microbatches: int = 0,
-              moe_experts: int = 0) -> nn.Module:
+              moe_experts: int = 0, pallas_dw: bool = False) -> nn.Module:
     """``attention``: 'full' (default, XLA-fused softmax attention),
     'ring' (sequence-parallel over ``mesh``'s 'model' axis via
     lax.ppermute — ops/attention.py), 'flash' (the Pallas kernel,
@@ -84,6 +84,19 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
         raise ValueError(f"attention must be 'full', 'ring', 'flash' or "
                          f"'ring_flash', got {attention!r}")
     dtype = jnp.bfloat16 if half_precision else jnp.float32
+    if pallas_dw:
+        # API-only knob (bench.py A/B path, no CLI flag): the measured
+        # closure in BASELINE.md found XLA's native dW at its roofline,
+        # so the kernel is kept as a tested experimental path, not a
+        # product default.
+        if name != "cnn":
+            raise ValueError(
+                "pallas_dw applies to the cnn model only (the "
+                "patch-reuse conv-dW kernel covers its 3x3/SAME convs)")
+        from .simple import SmallCNN
+
+        return SmallCNN(num_classes=num_classes, dtype=dtype,
+                        pallas_dw=True)
     if moe_experts:
         if name != "vit":
             raise ValueError(
@@ -105,11 +118,12 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
             raise ValueError(
                 "--pipeline-parallel applies to the attention model "
                 f"family only (--model vit); {name!r} has no stages")
-        if attention != "full" or tensor_parallel:
+        if attention not in ("full", "ring") or tensor_parallel:
             raise ValueError(
                 "--pipeline-parallel is exclusive with --attention "
-                "ring/flash and --tensor-parallel (the pipelined vit "
-                "hand-rolls its blocks)")
+                "flash/ring_flash and --tensor-parallel (the pipelined "
+                "vit hand-rolls its blocks); it composes with "
+                "--attention ring on a 3-D mesh (--seq-parallel >= 2)")
         from .vit_pipeline import PipelinedViT, make_pipeline_fn
         from ..runtime import MODEL_AXIS
 
@@ -124,7 +138,8 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
             pipeline_fn=make_pipeline_fn(mesh, mesh.shape[MODEL_AXIS],
                                          depth, heads,
                                          n_micro=pipeline_microbatches
-                                         or None))
+                                         or None,
+                                         ring=attention == "ring"))
     if attention != "full" or tensor_parallel or moe_experts:
         if name != "vit":
             feature = (f"--attention {attention}" if attention != "full"

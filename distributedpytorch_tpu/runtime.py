@@ -30,9 +30,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # Canonical mesh axis names.  Data parallelism ('data') is the reference's
 # one and only strategy (SURVEY §2 parallelism checklist); 'model' exists so
-# tensor-parallel shardings have a named axis to ride on.
+# tensor-parallel shardings have a named axis to ride on; 'seq' is the
+# third axis of the 3-D mesh the ring x pipeline composition uses
+# (pipeline stages over 'model', ring sequence parallelism over 'seq').
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
 
 _initialized = False
 
@@ -126,7 +129,7 @@ def world_size() -> int:
 
 
 def make_mesh(data_parallel: Optional[int] = None,
-              model_parallel: int = 1,
+              model_parallel: int = 1, seq_parallel: int = 1,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build the device mesh the SPMD train step runs over.
 
@@ -134,16 +137,25 @@ def make_mesh(data_parallel: Optional[int] = None,
     equivalent of the reference's world of DDP ranks.  ``model_parallel > 1``
     folds the same devices into a 2-D (data, model) mesh; XLA lays the 'data'
     axis over ICI so gradient reductions ride the fast interconnect.
+    ``seq_parallel > 1`` adds the third 'seq' axis (ring x pipeline:
+    stages on 'model', the attention ring on 'seq').
     """
     devs = np.array(devices if devices is not None else jax.devices())
     n = devs.size
-    if model_parallel < 1 or n % model_parallel:
+    if model_parallel < 1 or seq_parallel < 1 \
+            or n % (model_parallel * seq_parallel):
         raise ValueError(
-            f"model_parallel={model_parallel} must divide device count {n}")
-    dp = data_parallel if data_parallel is not None else n // model_parallel
-    if dp * model_parallel != n:
+            f"model_parallel={model_parallel} * seq_parallel={seq_parallel}"
+            f" must divide device count {n}")
+    dp = (data_parallel if data_parallel is not None
+          else n // (model_parallel * seq_parallel))
+    if dp * model_parallel * seq_parallel != n:
         raise ValueError(
-            f"data_parallel({dp}) * model_parallel({model_parallel}) != {n}")
+            f"data_parallel({dp}) * model_parallel({model_parallel}) * "
+            f"seq_parallel({seq_parallel}) != {n}")
+    if seq_parallel > 1:
+        return Mesh(devs.reshape(dp, model_parallel, seq_parallel),
+                    (DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
     return Mesh(devs.reshape(dp, model_parallel), (DATA_AXIS, MODEL_AXIS))
 
 
