@@ -19,19 +19,35 @@ from ..ops.pooling import max_pool_2x2
 class SmallCNN(nn.Module):
     """Conv-conv-pool x2 + dense.  Channel widths are multiples of 32/64 so
     XLA tiles the im2col matmuls cleanly onto the 128x128 MXU; pooling uses
-    the select-and-scatter-free max_pool_2x2 (ops/pooling.py)."""
+    the select-and-scatter-free max_pool_2x2 (ops/pooling.py).
+
+    ``pallas_dw=True`` swaps the multi-channel convs' WEIGHT-GRADIENT
+    computation for the patch-reuse Pallas kernel (ops/conv.py) — same
+    forward, same dx, same param tree (explicit ``Conv_i`` name slots),
+    so checkpoints are interchangeable.  Conv_0 (Ci=1) stays on nn.Conv:
+    its 9-row patch matrix can't fill a sublane tile and XLA's native dW
+    is already fine there."""
 
     num_classes: int = 10
     dtype: Any = jnp.bfloat16
+    pallas_dw: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
+        i = 0
         for width in (32, 64):
-            x = nn.Conv(width, (3, 3), padding="SAME", dtype=self.dtype)(x)
-            x = nn.relu(x)
-            x = nn.Conv(width, (3, 3), padding="SAME", dtype=self.dtype)(x)
-            x = nn.relu(x)
+            for _ in range(2):
+                if self.pallas_dw and x.shape[-1] >= 32:
+                    from ..ops.conv import Conv3x3
+
+                    x = Conv3x3(width, dtype=self.dtype,
+                                name=f"Conv_{i}")(x)
+                else:
+                    x = nn.Conv(width, (3, 3), padding="SAME",
+                                dtype=self.dtype, name=f"Conv_{i}")(x)
+                x = nn.relu(x)
+                i += 1
             x = max_pool_2x2(x)
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(256, dtype=self.dtype)(x))
