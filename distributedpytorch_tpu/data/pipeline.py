@@ -162,7 +162,11 @@ class ShardedLoader:
             for arrays in host_iter:
                 yield self._to_device(arrays)
             return
-        queue = collections.deque()
+        # Instance attribute (not a local) so tests/bench can assert the
+        # overlap actually happens: in steady state the queue holds the
+        # next batch(es) — already device_put, H2D in flight — while the
+        # consumer computes on the previous one.
+        queue = self._queue = collections.deque()
         try:
             while len(queue) < self.prefetch:
                 queue.append(self._to_device(next(host_iter)))

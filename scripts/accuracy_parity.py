@@ -55,7 +55,7 @@ def load_corpus(dataset: str, data_path: str, seed: int):
 # ------------------------------------------------------- reference (torch) --
 
 def run_reference(ds, epochs: int, batch: int, seed: int,
-                  train_limit: int) -> dict:
+                  train_limit: int, optimizer: str = "adam") -> dict:
     """The reference's train()+test() flow, faithfully (ref classif.py),
     with its transform pipeline done per-sample in PIL on host CPU."""
     import torch
@@ -123,7 +123,15 @@ def run_reference(ds, epochs: int, batch: int, seed: int,
             return self.head(F.relu(self.fc1(x.flatten(1))))
 
     model = SmallCNNTorch()
-    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    # ref classif.py:122-131: Adam(1e-3) or SGD(1e-3, momentum 0.9) +
+    # StepLR(step_size=1, gamma=0.1) stepped per epoch (SGD only)
+    scheduler = None
+    if optimizer == "sgd":
+        opt = torch.optim.SGD(model.parameters(), lr=1e-3, momentum=0.9)
+        scheduler = torch.optim.lr_scheduler.StepLR(opt, step_size=1,
+                                                    gamma=0.1)
+    else:
+        opt = torch.optim.Adam(model.parameters(), lr=1e-3)
     criterion = nn.CrossEntropyLoss()
 
     tr = ds.splits["train"]
@@ -175,6 +183,8 @@ def run_reference(ds, epochs: int, batch: int, seed: int,
             # (ref classif.py:188-192), so the test column evaluates the
             # best-valid model — symmetric with ours' best-checkpoint load.
             best_state = copy.deepcopy(model.state_dict())
+        if scheduler is not None:  # ref classif.py:168-169
+            scheduler.step()
     model.load_state_dict(best_state)
     te_loss, te_acc = run_epoch(ds.splits["test"], False)
     log(f"[ref] test acc {te_acc:.4f} ({time.monotonic() - t0:.0f}s)")
@@ -188,7 +198,8 @@ def run_reference(ds, epochs: int, batch: int, seed: int,
 # ------------------------------------------------------------------- ours --
 
 def run_ours(dataset: str, data_path: str, epochs: int, batch: int,
-             seed: int, rsl: str, train_limit: int) -> dict:
+             seed: int, rsl: str, train_limit: int,
+             optimizer: str = "adam") -> dict:
     from distributedpytorch_tpu import checkpoint as ckpt
     from distributedpytorch_tpu.cli import run_test, run_train
     from distributedpytorch_tpu.config import Config
@@ -200,7 +211,7 @@ def run_ours(dataset: str, data_path: str, epochs: int, batch: int,
     t0 = time.monotonic()
     cfg = Config(action="train", data_path=data_path, rsl_path=rsl,
                  dataset=dataset, model_name="cnn", batch_size=batch,
-                 nb_epochs=epochs, seed=seed,
+                 nb_epochs=epochs, seed=seed, optimizer=optimizer,
                  synthetic_fallback=dataset.startswith("synthetic"))
     result = run_train(cfg)
     best = ckpt.best_model_path(rsl, dataset, "cnn")
@@ -236,6 +247,10 @@ def main() -> int:
     p.add_argument("--train-limit", type=int, default=0,
                    help="cap reference-side train samples/epoch (torch-CPU "
                         "wall-clock control; 0 = full split)")
+    p.add_argument("--optimizer", choices=("adam", "sgd"), default="adam",
+                   help="both sides: adam(1e-3) or sgd(1e-3, momentum .9) "
+                        "+ per-epoch StepLR(gamma .1) (ref "
+                        "classif.py:122-131)")
     p.add_argument("--skip-ours", action="store_true")
     p.add_argument("--skip-reference", action="store_true")
     args = p.parse_args()
@@ -256,14 +271,15 @@ def main() -> int:
     ds = load_corpus(dataset, args.data_path, args.seed)
     ours = (None if args.skip_ours else
             run_ours(dataset, args.data_path, args.epochs, args.batch,
-                     args.seed, args.rsl, args.train_limit))
+                     args.seed, args.rsl, args.train_limit,
+                     args.optimizer))
     ref = (None if args.skip_reference else
            run_reference(ds, args.epochs, args.batch, args.seed,
-                         args.train_limit))
+                         args.train_limit, args.optimizer))
 
     out = {"dataset": dataset, "epochs": args.epochs, "batch": args.batch,
            "seed": args.seed, "train_limit": args.train_limit,
-           "ours": ours, "reference": ref}
+           "optimizer": args.optimizer, "ours": ours, "reference": ref}
     if ours and ref:
         out["test_acc_delta"] = round(ours["test_acc"] - ref["test_acc"], 4)
         log(f"| {dataset} ({args.epochs} epochs, batch {args.batch}) "

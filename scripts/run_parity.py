@@ -1,0 +1,89 @@
+#!/usr/bin/env python3
+"""Run the full accuracy-parity suite and assemble PARITY.json.
+
+5 Adam seeds (the round-3 protocol, re-pinned on the current tree) plus
+one SGD+StepLR seed-pair (ref classif.py:122-131's second optimizer
+path) — VERDICT r5 item 4.  Each run shells out to accuracy_parity.py
+so ours and the reference see identical corpora per seed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SEEDS = [1234, 7, 99, 41, 2024]
+SGD_SEEDS = [1234]
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def one(seed: int, optimizer: str) -> dict:
+    cmd = [sys.executable, os.path.join(REPO, "scripts",
+                                        "accuracy_parity.py"),
+           "--dataset", "synthetic_hard", "--seed", str(seed),
+           "--optimizer", optimizer,
+           "--rsl", f"/tmp/parity_rsl_{optimizer}_{seed}"]
+    log(f"=== parity seed {seed} optimizer {optimizer} ===")
+    res = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                         timeout=3600)
+    if res.returncode != 0:
+        log(res.stderr[-4000:])
+        raise RuntimeError(f"parity run failed (seed {seed})")
+    return json.loads(res.stdout.strip().splitlines()[-1])
+
+
+def main() -> int:
+    runs = [one(s, "adam") for s in SEEDS]
+    sgd_runs = [one(s, "sgd") for s in SGD_SEEDS]
+
+    ours = [r["ours"]["test_acc"] for r in runs]
+    ref = [r["reference"]["test_acc"] for r in runs]
+    deltas = [round((o - r) * 100, 2) for o, r in zip(ours, ref)]
+    out = {
+        "round": 5,
+        "corpus": "synthetic_hard (data/io.py SYNTH_HARD: class_sep 0.45,"
+                  " noise 70)",
+        "protocol": "2 epochs, batch 64, best-valid-loss model both "
+                    "sides, identical corpus/split per seed",
+        "n_seeds": len(SEEDS),
+        "seeds": SEEDS,
+        "ours_test_acc": ours,
+        "reference_test_acc": ref,
+        "deltas_pp": deltas,
+        "mean_ours": round(statistics.mean(ours) * 100, 2),
+        "mean_reference": round(statistics.mean(ref) * 100, 2),
+        "mean_delta_pp": round(statistics.mean(deltas), 2),
+        "sd_delta_pp": round(statistics.stdev(deltas), 2),
+        "sd_ours_pp": round(statistics.stdev(ours) * 100, 2),
+        "sd_reference_pp": round(statistics.stdev(ref) * 100, 2),
+        "sgd": [{
+            "seed": r["seed"],
+            "ours_test_acc": r["ours"]["test_acc"],
+            "reference_test_acc": r["reference"]["test_acc"],
+            "delta_pp": round((r["ours"]["test_acc"]
+                               - r["reference"]["test_acc"]) * 100, 2),
+        } for r in sgd_runs],
+        "runs": runs + sgd_runs,
+    }
+    adam_ok = abs(out["mean_delta_pp"]) <= 2 * out["sd_delta_pp"]
+    out["conclusion"] = (
+        f"adam: mean delta {out['mean_delta_pp']:+.2f}pp vs per-seed sd "
+        f"{out['sd_delta_pp']:.2f}pp ({'within' if adam_ok else 'OUTSIDE'}"
+        " spread); sgd+StepLR seed-pair delta "
+        f"{out['sgd'][0]['delta_pp']:+.2f}pp")
+    path = os.path.join(REPO, "PARITY.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    log(f"wrote {path}: {out['conclusion']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

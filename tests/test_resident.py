@@ -94,3 +94,27 @@ def test_resident_eval_epoch_matches_streaming(setup):
 
     for k in totals:
         assert float(tot_res[k]) == pytest.approx(totals[k], rel=1e-5)
+
+
+def test_prefetch_queue_overlaps(setup):
+    """VERDICT r5 item 6 (timing structure): with prefetch=N, the loader
+    keeps the next batch(es) device_put — H2D in flight — while the
+    consumer holds the previous one.  The queue must be (a) primed to
+    depth N before the first yield and (b) non-empty through steady
+    state, draining only for the final batches."""
+    split, mesh, _, _make_state = setup
+    loader = ShardedLoader(split, mesh, 4, shuffle=True, seed=7,
+                           prefetch=2)
+    n = len(loader)
+    depths = []
+    for i, (imgs, labels, valid) in enumerate(loader.epoch(0)):
+        depths.append(len(loader._queue))
+        assert imgs.shape[0] == loader.global_batch
+    assert len(depths) == n
+    # At yield time one slot was just popped and refills only after
+    # control returns to the generator, so steady-state depth observed
+    # by the consumer is prefetch-1 — i.e. one full batch is already on
+    # device (H2D in flight) while this one is being consumed.
+    assert all(d == 1 for d in depths[:-1]), depths
+    # the tail drains: the last yield has nothing queued behind it
+    assert depths[-1] == 0, depths
