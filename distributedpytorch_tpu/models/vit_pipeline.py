@@ -17,6 +17,12 @@ TPU-native design:
     the standard SPMD-GPipe trade that keeps control flow static for XLA;
   * the data axis is untouched: batches stay sharded over 'data', so PP
     composes with data parallelism on the same 2-D mesh;
+  * PP also composes with RING sequence parallelism on a 3-D
+    (data, model, seq) mesh (make_pipeline_fn(ring=True), CLI
+    --seq-parallel N): tokens are sharded over 'seq' and each stage's
+    attention runs the per-device ring body
+    (ops.attention._ring_attention_local) — K/V rotate over 'seq'
+    while microbatches flow over 'model';
   * backward is plain jax AD through the scan + ppermute — the reverse
     schedule (activations flowing backward through stages) falls out of
     the transpose of ppermute.

@@ -55,7 +55,8 @@ def load_corpus(dataset: str, data_path: str, seed: int):
 # ------------------------------------------------------- reference (torch) --
 
 def run_reference(ds, epochs: int, batch: int, seed: int,
-                  train_limit: int, optimizer: str = "adam") -> dict:
+                  train_limit: int, optimizer: str = "adam",
+                  init: str = "torch") -> dict:
     """The reference's train()+test() flow, faithfully (ref classif.py),
     with its transform pipeline done per-sample in PIL on host CPU."""
     import torch
@@ -123,6 +124,19 @@ def run_reference(ds, epochs: int, batch: int, seed: int,
             return self.head(F.relu(self.fc1(x.flatten(1))))
 
     model = SmallCNNTorch()
+    if init == "lecun":
+        # Diagnostic CONTROL, not the reference recipe: flax-style init
+        # (lecun-normal weights, zero biases) on the torch model —
+        # isolates whether an SGD learning gap is an init effect
+        # (torch's kaiming-uniform(a=sqrt(5)) + uniform biases) rather
+        # than an optimizer-dynamics divergence.
+        for m in model.modules():
+            if isinstance(m, (nn.Conv2d, nn.Linear)):
+                fan_in = (m.weight[0].numel()  # in_ch * kH * kW
+                          if isinstance(m, nn.Conv2d)
+                          else m.weight.shape[1])
+                nn.init.normal_(m.weight, std=fan_in ** -0.5)
+                nn.init.zeros_(m.bias)
     # ref classif.py:122-131: Adam(1e-3) or SGD(1e-3, momentum 0.9) +
     # StepLR(step_size=1, gamma=0.1) stepped per epoch (SGD only)
     scheduler = None
@@ -211,7 +225,10 @@ def run_ours(dataset: str, data_path: str, epochs: int, batch: int,
     t0 = time.monotonic()
     cfg = Config(action="train", data_path=data_path, rsl_path=rsl,
                  dataset=dataset, model_name="cnn", batch_size=batch,
-                 nb_epochs=epochs, seed=seed, optimizer=optimizer,
+                 nb_epochs=epochs, seed=seed,
+                 # the framework spells it like the reference (config.py
+                 # OPTIMIZER_CHOICES: 'adam' | 'SGD')
+                 optimizer="SGD" if optimizer == "sgd" else optimizer,
                  synthetic_fallback=dataset.startswith("synthetic"))
     result = run_train(cfg)
     best = ckpt.best_model_path(rsl, dataset, "cnn")
@@ -251,6 +268,11 @@ def main() -> int:
                    help="both sides: adam(1e-3) or sgd(1e-3, momentum .9) "
                         "+ per-epoch StepLR(gamma .1) (ref "
                         "classif.py:122-131)")
+    p.add_argument("--ref-init", choices=("torch", "lecun"),
+                   default="torch",
+                   help="reference-side weight init: 'torch' (the real "
+                        "reference, torchvision defaults) or 'lecun' "
+                        "(flax-style control — diagnostic only)")
     p.add_argument("--skip-ours", action="store_true")
     p.add_argument("--skip-reference", action="store_true")
     args = p.parse_args()
@@ -275,11 +297,13 @@ def main() -> int:
                      args.optimizer))
     ref = (None if args.skip_reference else
            run_reference(ds, args.epochs, args.batch, args.seed,
-                         args.train_limit, args.optimizer))
+                         args.train_limit, args.optimizer,
+                         args.ref_init))
 
     out = {"dataset": dataset, "epochs": args.epochs, "batch": args.batch,
            "seed": args.seed, "train_limit": args.train_limit,
-           "optimizer": args.optimizer, "ours": ours, "reference": ref}
+           "optimizer": args.optimizer, "ref_init": args.ref_init,
+           "ours": ours, "reference": ref}
     if ours and ref:
         out["test_acc_delta"] = round(ours["test_acc"] - ref["test_acc"], 4)
         log(f"| {dataset} ({args.epochs} epochs, batch {args.batch}) "
