@@ -647,6 +647,20 @@ def pipeline_child() -> None:
             "predicted_work_ratio": (P + m - 1) / m,
             "bubble_fraction": (P - 1) / (P + m - 1),
         }
+    # The ring x pipeline COMPOSITION on the 3-D (2 data, 2 stage,
+    # 2 seq) mesh — same caveat: one core, wall time ~ total work, so
+    # the row measures the composition's mechanism overhead (ring
+    # rotations inside every stage tick), not TPU speed.  Value is
+    # pinned to the plain sequential schedule in tests/test_pipeline.py.
+    mesh3 = runtime.make_mesh(model_parallel=2, seq_parallel=2)
+    t_rpp = timed(make_pipeline_fn(mesh3, 2, DEPTH, HEADS, ring=True))
+    out["ring_pipeline_p2s2"] = {
+        "ms": t_rpp * 1e3, "vs_sequential": t_rpp / t_seq,
+        # GPipe work ratio for P=2, M=2; the ring's rotation work inside
+        # every stage tick comes on top of it
+        "predicted_work_ratio": (2 + 2 - 1) / 2,
+        "mesh": "2 data x 2 stage x 2 seq",
+    }
     print(json.dumps(out), flush=True)
 
 

@@ -93,3 +93,12 @@ def test_smallcnn_flag_same_tree_and_close_grads():
                     jax.tree_util.tree_leaves(g1)):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=2e-4, atol=2e-3)
+
+
+def test_pallas_dw_registry_validation():
+    from distributedpytorch_tpu.models import get_model
+
+    with pytest.raises(ValueError, match="cnn model only"):
+        get_model("vit", 10, pallas_dw=True)
+    model = get_model("cnn", 10, pallas_dw=True, half_precision=False)
+    assert getattr(model, "pallas_dw") is True
