@@ -118,3 +118,12 @@ def test_pretrained_file_without_state_dict_exits_cleanly(tmp_path):
                     "-e", "1", "--use-pretrained",
                     "--pretrained-path", str(w)))
     assert rc == 1
+
+
+def test_seq_parallel_argv_roundtrip():
+    cfg = config_from_argv(["train", "-d", "/x", "--model", "vit",
+                            "--attention", "ring", "--pipeline-parallel",
+                            "--model-parallel", "2",
+                            "--seq-parallel", "2"])
+    assert cfg.seq_parallel == 2 and cfg.pipeline_parallel
+    assert config_from_argv(["train", "-d", "/x"]).seq_parallel == 1
