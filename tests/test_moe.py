@@ -121,6 +121,35 @@ def test_expert_sharded_equals_replicated():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_expert_sharded_equals_replicated_multi_group():
+    """EP x grouped dispatch: with SEVERAL dispatch groups (the round-5
+    linear-cost formulation) the expert-sharded program still equals the
+    replicated one — group axis sharding propagates from the batch while
+    experts ride 'model'."""
+    from distributedpytorch_tpu.models import moe
+    from distributedpytorch_tpu.parallel import make_tp_constrain
+
+    mesh = runtime.make_mesh(model_parallel=2)
+    x = jax.random.normal(jax.random.PRNGKey(14), (16, 8, DIM),
+                          jnp.float32)
+    orig = moe.GROUP_TOKENS
+    moe.GROUP_TOKENS = 32  # force 4 groups of 4 rows
+    try:
+        plain = _mlp(capacity_factor=2.0)
+        params = plain.init({"params": jax.random.PRNGKey(15)},
+                            x)["params"]
+        want = plain.apply({"params": params}, x)
+        sharded = _mlp(capacity_factor=2.0,
+                       ep_constrain=make_tp_constrain(mesh))
+        with mesh:
+            got = jax.jit(
+                lambda p, a: sharded.apply({"params": p}, a))(params, x)
+    finally:
+        moe.GROUP_TOKENS = orig
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_load_balance_loss_reaches_training_loss():
     """The sown aux loss must change the optimized scalar: the train-mode
     loss differs from the pure CE loss by the load-balance term, and the
