@@ -44,28 +44,17 @@ import time
 
 import numpy as np
 
-# Published peak dense bf16 FLOP/s per chip, keyed by device_kind substring
-# (lowercased).  Unknown kinds (incl. CPU) report mfu: null.
-PEAK_BF16_FLOPS = [
-    ("v6e", 918e12), ("v6 lite", 918e12),
-    ("v5p", 459e12),
-    ("v5e", 197e12), ("v5 lite", 197e12), ("v5litepod", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-]
-
-
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
 def peak_flops(device_kind: str) -> float | None:
-    kind = device_kind.lower()
-    for key, peak in PEAK_BF16_FLOPS:
-        if key in kind:
-            return peak
-    return None
+    # Single source of truth for the peak table: ops/flops.py (shared
+    # with the telemetry MFU gauge).  Imported lazily — bench.py sets up
+    # the platform before importing the framework.
+    from distributedpytorch_tpu.ops.flops import peak_flops as _pf
+
+    return _pf(device_kind)
 
 
 def _force_sync_timing_mode() -> None:

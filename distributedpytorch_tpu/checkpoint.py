@@ -49,6 +49,7 @@ import numpy as np
 from flax import serialization
 from jax.sharding import NamedSharding, PartitionSpec
 
+from . import telemetry
 from .models import vit_pipeline
 from .train.engine import TrainState
 
@@ -112,23 +113,26 @@ def save_checkpoint(path: str, model_name: str, state: TrainState,
     state (the internal call below is then a no-op; it only covers
     single-host callers).  For orbax, EVERY process calls this (each host
     writes its own shards) and no gather happens at all."""
-    if fmt == "orbax":
-        return _save_orbax(path, model_name, state, epoch, best_valid_loss)
-    payload = {
-        "format_version": _FORMAT_VERSION,
-        "model_name": model_name,
-        "epoch": int(epoch),
-        "loss": float(best_valid_loss),
-        "state": serialization.to_state_dict(
-            jax.device_get(gather_replicated(state))),
-    }
-    blob = serialization.msgpack_serialize(payload)
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(blob)
-    os.replace(tmp, path)
-    logging.info(f"epoch:{epoch:04d}: model saved to {path}")
+    with telemetry.get().span("ckpt_save", fmt=fmt, epoch=int(epoch),
+                              file=os.path.basename(path)):
+        if fmt == "orbax":
+            return _save_orbax(path, model_name, state, epoch,
+                               best_valid_loss)
+        payload = {
+            "format_version": _FORMAT_VERSION,
+            "model_name": model_name,
+            "epoch": int(epoch),
+            "loss": float(best_valid_loss),
+            "state": serialization.to_state_dict(
+                jax.device_get(gather_replicated(state))),
+        }
+        blob = serialization.msgpack_serialize(payload)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        logging.info(f"epoch:{epoch:04d}: model saved to {path}")
 
 
 def require_orbax() -> None:
@@ -319,10 +323,23 @@ def _load_orbax(path: str, state: TrainState, restore_optimizer: bool
             # from_state_dict.
             abstract.pop("opt_state", None)
             with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ptc:
+                try:
+                    args = ocp.args.PyTreeRestore(item=abstract,
+                                                  partial_restore=True)
+                except TypeError:
+                    # older orbax spells partial restore via transforms:
+                    # an empty mapping with default-to-original restores
+                    # exactly the item's keys and drops the rest (the
+                    # saved opt_state) without reading it; restore_args
+                    # (sharding/dtype per leaf) are mandatory with
+                    # transforms and derived from the abstract target
+                    args = ocp.args.PyTreeRestore(
+                        item=abstract,
+                        restore_args=ocp.checkpoint_utils
+                        .construct_restore_args(abstract),
+                        transforms={})
                 restored_dict = ptc.restore(
-                    os.path.join(path, "state"),
-                    args=ocp.args.PyTreeRestore(item=abstract,
-                                                partial_restore=True))
+                    os.path.join(path, "state"), args=args)
     except Exception as e:
         raise ValueError(f"cannot restore orbax checkpoint {path!r}: "
                          f"{e}") from e
@@ -365,6 +382,14 @@ def load_checkpoint(path: str, state: TrainState,
     best_valid_loss).  ``state`` is a template with the right structure
     (fresh Engine.init_state output); restored arrays replace its leaves.
     Format is auto-detected: an orbax checkpoint is a directory."""
+    with telemetry.get().span("ckpt_restore",
+                              file=os.path.basename(path)):
+        return _load_checkpoint_inner(path, state, restore_optimizer)
+
+
+def _load_checkpoint_inner(path: str, state: TrainState,
+                           restore_optimizer: bool
+                           ) -> Tuple[TrainState, int, float]:
     if os.path.isdir(path):
         return _load_orbax(path, state, restore_optimizer)
     payload = _read(path)

@@ -12,13 +12,14 @@ from __future__ import annotations
 import logging
 import os
 import sys
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from . import checkpoint as ckpt
-from . import parallel, runtime, utils
+from . import parallel, runtime, telemetry, utils
 from .config import Config, config_from_argv
 from .data import augment  # noqa: F401  (re-exported for drivers/tests)
 from .data.datasets import Dataset, Split, load_dataset
@@ -130,20 +131,50 @@ def _make_loader(cfg: Config, split: Split, mesh, shuffle: bool):
                prefetch=cfg.prefetch)
 
 
+def _mfu_factors(engine: Engine) -> tuple:
+    """(flops_per_sample, peak_flops_per_chip) for the telemetry MFU
+    gauge — analytic model FLOPs (engine.init_state's jaxpr count) over
+    the chip's published bf16 peak.  Either may be None (untraceable
+    model / unknown device kind, e.g. CPU); the gauge is then omitted."""
+    from .ops.flops import peak_flops
+
+    fps = getattr(engine, "_flops_per_sample", None)
+    devs = jax.devices()
+    peak = peak_flops(devs[0].device_kind) if devs else None
+    return fps, peak
+
+
+def _record_throughput(tel, sps_chip: float, fps, peak, epoch: int) -> None:
+    """North-star gauges, per epoch: samples/s/chip always; MFU as a
+    fraction of the chip's bf16 peak when the model FLOPs and the peak
+    are both known, an explicit recorded null otherwise (CPU / unknown
+    device kind) so every run's JSONL documents the metric."""
+    tel.gauge("throughput/samples_per_sec_per_chip").set(sps_chip,
+                                                         epoch=epoch)
+    if fps and peak:
+        tel.gauge("throughput/mfu").set(sps_chip * fps / peak, epoch=epoch)
+    else:
+        tel.gauge("throughput/mfu").set(
+            None, epoch=epoch,
+            reason="unknown_peak" if fps else "unknown_model_flops")
+
+
 def _run_eval_pass(engine: Engine, state, loader, epoch: int
                    ) -> tuple[float, float]:
     """One no-grad pass; returns globally-reduced (loss, accuracy)."""
-    if isinstance(loader, ResidentLoader):
-        idx, valid = loader.epoch_plan(epoch)
-        totals = engine.eval_epoch(state, loader.images, loader.labels,
-                                   idx, valid)
-    else:
-        totals = None
-        for images, labels, valid in loader.epoch(epoch):
-            m = engine.eval_step(state, images, labels, valid)
-            totals = m if totals is None else jax.tree_util.tree_map(
-                jnp.add, totals, m)
-    totals = jax.device_get(totals)
+    tel = telemetry.get()
+    with tel.span("eval_pass", epoch=epoch, steps=len(loader)):
+        if isinstance(loader, ResidentLoader):
+            idx, valid = loader.epoch_plan(epoch)
+            totals = engine.eval_epoch(state, loader.images, loader.labels,
+                                       idx, valid)
+        else:
+            totals = None
+            for images, labels, valid in loader.epoch(epoch):
+                m = engine.eval_step(state, images, labels, valid)
+                totals = m if totals is None else jax.tree_util.tree_map(
+                    jnp.add, totals, m)
+        totals = jax.device_get(totals)
     loss = float(totals["loss_numer"] / max(totals["loss_denom"], 1e-9))
     acc = float(totals["correct"] / max(totals["valid"], 1.0))
     return loss, acc
@@ -167,13 +198,20 @@ def _run_train_pass(engine: Engine, state, loader, epoch: int, key
     """One optimization pass (ref processData train branch,
     classif.py:41-69), with the progress print + every-10% log."""
     nb_iters = len(loader)
+    tel = telemetry.get()
     if isinstance(loader, ResidentLoader):
         # Whole epoch in one XLA dispatch; per-step metrics come back as
         # (steps,) arrays and the in-epoch log lines are emitted from them.
+        # The telemetry span encloses the device_get, so its duration is
+        # the real compute wall-clock, and the StepTraceAnnotation makes
+        # the dispatch findable in a --profile trace by the same name.
         idx, valid = loader.epoch_plan(epoch)
-        state, metrics = engine.train_epoch(
-            state, loader.images, loader.labels, idx, valid, key)
-        metrics = jax.device_get(metrics)
+        with jax.profiler.StepTraceAnnotation("train_dispatch",
+                                              step_num=epoch), \
+                tel.span("train_dispatch", epoch=epoch, steps=nb_iters):
+            state, metrics = engine.train_epoch(
+                state, loader.images, loader.labels, idx, valid, key)
+            metrics = jax.device_get(metrics)
         if runtime.is_main():
             _progress_logs(epoch, metrics["loss"])
         epoch_loss = float(np.mean(metrics["loss"]))
@@ -186,9 +224,26 @@ def _run_train_pass(engine: Engine, state, loader, epoch: int, key
     # the end feeds the every-10% log lines retroactively via
     # _progress_logs.  (Previously each 10% boundary called float() on a
     # device value — a blocking sync in the middle of the epoch.)
+    #
+    # Telemetry: the per-step histogram measures host-side dispatch time
+    # (enqueue, not device completion — dispatch is async; the epoch-end
+    # device_get absorbs the backlog).  Complementary to the pipeline's
+    # data/wait_s counters: together they split host time into data wait
+    # vs step dispatch.  Gated on tel.enabled so the off path runs the
+    # original loop with zero added per-step work.
+    step_hist = tel.histogram("step/dispatch_s") if tel.enabled else None
     loss_hist, correct_hist, valid_hist = [], [], []
     for i, (images, labels, valid) in enumerate(loader.epoch(epoch)):
-        state, metrics = engine.train_step(state, images, labels, valid, key)
+        if step_hist is not None:
+            t0 = time.perf_counter()
+            with jax.profiler.StepTraceAnnotation(
+                    "train_step", step_num=epoch * nb_iters + i):
+                state, metrics = engine.train_step(state, images, labels,
+                                                   valid, key)
+            step_hist.observe(time.perf_counter() - t0)
+        else:
+            state, metrics = engine.train_step(state, images, labels,
+                                               valid, key)
         loss_hist.append(metrics["loss"])
         correct_hist.append(metrics["correct"])
         valid_hist.append(metrics["valid"])
@@ -217,6 +272,8 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
     checkpoint (and any best-model save) happens once per chunk.
     """
     history = []
+    tel = telemetry.get()
+    fps, peak = _mfu_factors(engine) if tel.enabled else (None, None)
     epoch = start_epoch
     while epoch < cfg.nb_epochs:
         chunk = list(range(epoch,
@@ -226,16 +283,25 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
         idx_tr, valid_tr = train_loader.epoch_plan_many(chunk)
         idx_va, valid_va = valid_loader.epoch_plan_many(chunk)
         keys = jnp.stack([utils.fold_key(root, e) for e in chunk])
-        state, out = engine.train_epochs(
-            state, train_loader.images, train_loader.labels, idx_tr,
-            valid_tr, valid_loader.images, valid_loader.labels, idx_va,
-            valid_va, keys)
-        out = jax.device_get(out)
+        # K fused epochs = ONE dispatch: the span (device_get included)
+        # is the real compute wall-clock for the whole chunk, annotated
+        # so --profile traces carry the same name.
+        with jax.profiler.StepTraceAnnotation("chunk_dispatch",
+                                              step_num=epoch), \
+                tel.span("chunk_dispatch", first_epoch=epoch,
+                         epochs=len(chunk)):
+            state, out = engine.train_epochs(
+                state, train_loader.images, train_loader.labels, idx_tr,
+                valid_tr, valid_loader.images, valid_loader.labels, idx_va,
+                valid_va, keys)
+            out = jax.device_get(out)
         end = utils.monotonic()
 
         per_epoch_s = (end - chunk_start) / len(chunk)
         train_samples = len(train_loader) * train_loader.global_batch
         sps_chip = train_samples / max(per_epoch_s, 1e-9) / world
+        if tel.enabled:
+            _record_throughput(tel, sps_chip, fps, peak, chunk[-1])
         chunk_improved = False
         for k, e in enumerate(chunk):
             train_loss = float(np.mean(out["train_loss"][k]))
@@ -294,12 +360,14 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
                                             model_name),
                        model_name, saveable, last, best_valid_loss)
         epoch = last + 1
+        tel.flush()  # chunk boundary: buffered events hit the disk
         # Agreed across hosts so everyone leaves at the same chunk
         # boundary.  Granularity is the K-epoch chunk: one XLA dispatch
         # cannot be interrupted (documented trade-off of
         # --epochs-per-dispatch; size the grace window accordingly).
         if runtime.any_process(shutdown.requested):
             shutdown.requested = True
+            tel.event("preempt", after_epoch=last)
             if runtime.is_main():
                 logging.info(f"preempted after epoch {last + 1}: "
                              f"checkpoint written, resume with -f")
@@ -314,9 +382,16 @@ def run_train(cfg: Config) -> dict:
     runtime.initialize_distributed()
     utils.initialize_logging(cfg.rsl_path, cfg.log_file,
                              truncate=runtime.is_main())
+    # After distributed init so the rank in the filename is the GLOBAL
+    # process index (per-rank files are the multi-host contract).
+    tel = telemetry.configure(cfg.rsl_path, cfg.telemetry)
     mesh = runtime.make_mesh(model_parallel=cfg.model_parallel,
                              seq_parallel=cfg.seq_parallel)
     world = runtime.world_size()
+    tel.event("run_start", action="train", model=cfg.model_name,
+              dataset=cfg.dataset, world=world,
+              processes=runtime.process_count(),
+              batch_per_replica=cfg.batch_size)
     if runtime.is_main():
         logging.info(f"process: {runtime.process_index()}/"
                      f"{runtime.process_count()}, world size: {world}")
@@ -490,16 +565,22 @@ def run_train(cfg: Config) -> dict:
 
     start_time = utils.monotonic()
     shutdown = utils.GracefulShutdown()
-    with shutdown:
-        if use_chunks:
-            return _run_train_chunked(cfg, engine, state, train_loader,
-                                      valid_loader, model_name, root,
-                                      start_epoch, best_valid_loss,
-                                      start_time, world, shutdown)
-        return _run_train_epochs(cfg, engine, state, train_loader,
-                                 valid_loader, model_name, root,
-                                 start_epoch, best_valid_loss, start_time,
-                                 world, shutdown)
+    try:
+        with shutdown:
+            if use_chunks:
+                return _run_train_chunked(cfg, engine, state, train_loader,
+                                          valid_loader, model_name, root,
+                                          start_epoch, best_valid_loss,
+                                          start_time, world, shutdown)
+            return _run_train_epochs(cfg, engine, state, train_loader,
+                                     valid_loader, model_name, root,
+                                     start_epoch, best_valid_loss,
+                                     start_time, world, shutdown)
+    finally:
+        # Counter/histogram summaries are emitted here — also on an
+        # exception/preemption path, so a killed run still leaves a
+        # readable telemetry trail.
+        tel.close()
 
 
 def _run_train_epochs(cfg: Config, engine: Engine, state, train_loader,
@@ -508,6 +589,8 @@ def _run_train_epochs(cfg: Config, engine: Engine, state, train_loader,
                       shutdown) -> dict:
     """The per-epoch driver loop (ref classif.py:151-192)."""
     history = []
+    tel = telemetry.get()
+    fps, peak = _mfu_factors(engine) if tel.enabled else (None, None)
     for epoch in range(start_epoch, cfg.nb_epochs):
         if runtime.is_main():
             print(f"====================== epoch{epoch + 1:4d} "
@@ -520,11 +603,14 @@ def _run_train_epochs(cfg: Config, engine: Engine, state, train_loader,
             jax.profiler.start_trace(f"{cfg.rsl_path}/trace")
 
         epoch_key = utils.fold_key(root, epoch)
-        state, train_loss, train_acc = _run_train_pass(
-            engine, state, train_loader, epoch, epoch_key)
-        train_end = utils.monotonic()
-        valid_loss, valid_acc = _run_eval_pass(
-            engine, state, valid_loader, epoch)
+        with tel.span("epoch", epoch=epoch):
+            with tel.span("train_pass", epoch=epoch,
+                          steps=len(train_loader)):
+                state, train_loss, train_acc = _run_train_pass(
+                    engine, state, train_loader, epoch, epoch_key)
+            train_end = utils.monotonic()
+            valid_loss, valid_acc = _run_eval_pass(
+                engine, state, valid_loader, epoch)
 
         if tracing:
             jax.profiler.stop_trace()
@@ -537,6 +623,8 @@ def _run_train_epochs(cfg: Config, engine: Engine, state, train_loader,
         mins, _secs = utils.get_duration(start_time, end)
         train_samples = len(train_loader) * train_loader.global_batch
         sps_chip = train_samples / max(train_end - epoch_start, 1e-9) / world
+        if tel.enabled:
+            _record_throughput(tel, sps_chip, fps, peak, epoch)
 
         # Update best BEFORE any checkpoint write so the rolling file
         # carries the post-epoch best; saving it first would make a resume
@@ -571,11 +659,13 @@ def _run_train_epochs(cfg: Config, engine: Engine, state, train_loader,
         history.append({"epoch": epoch, "train_loss": train_loss,
                         "train_acc": train_acc, "valid_loss": valid_loss,
                         "valid_acc": valid_acc})
+        tel.flush()  # epoch boundary: buffered events hit the disk
         # Agreed across hosts (runtime.any_process) so every process
         # leaves the loop at the SAME epoch — a lone host breaking early
         # would deadlock the others in the next collective.
         if runtime.any_process(shutdown.requested):
             shutdown.requested = True
+            tel.event("preempt", after_epoch=epoch)
             if runtime.is_main():
                 logging.info(f"preempted after epoch {epoch + 1}: "
                              f"checkpoint written, resume with -f")
@@ -596,11 +686,28 @@ def run_test(cfg: Config) -> dict:
             "--use-pretrained is not applicable to the test subcommand: "
             "weights come from -f FILE")
     _validate_ckpt_format(cfg)
+    # Same --seq-parallel composition guard run_train enforces (ADVICE #3):
+    # without it run_test builds a 3-D mesh for ANY --seq-parallel value,
+    # silently shrinking data-parallel width for a non-ring eval.
+    if cfg.seq_parallel > 1 and not (cfg.pipeline_parallel
+                                     and cfg.attention == "ring"):
+        raise ValueError(
+            "--seq-parallel >= 2 is the ring x pipeline composition's "
+            "third mesh axis: it requires --pipeline-parallel with "
+            "--attention ring; got "
+            f"seq_parallel={cfg.seq_parallel}, "
+            f"attention={cfg.attention!r}, "
+            f"pipeline_parallel={cfg.pipeline_parallel}")
     runtime.initialize_distributed()
     utils.initialize_logging(cfg.rsl_path, cfg.log_file,
                              truncate=runtime.is_main())
+    tel = telemetry.configure(cfg.rsl_path, cfg.telemetry)
     mesh = runtime.make_mesh(model_parallel=cfg.model_parallel,
                              seq_parallel=cfg.seq_parallel)
+    tel.event("run_start", action="test", dataset=cfg.dataset,
+              world=runtime.world_size(),
+              processes=runtime.process_count(),
+              batch_per_replica=cfg.batch_size)
     if runtime.is_main():
         logging.info(f"process: {runtime.process_index()}/"
                      f"{runtime.process_count()}, world size: "
@@ -625,7 +732,10 @@ def run_test(cfg: Config) -> dict:
     state = _place_state(state, mesh, cfg)
 
     start_time = utils.monotonic()
-    loss, acc = _run_eval_pass(engine, state, test_loader, epoch=0)
+    try:
+        loss, acc = _run_eval_pass(engine, state, test_loader, epoch=0)
+    finally:
+        tel.close()
     mins, secs = utils.get_duration(start_time, utils.monotonic())
     if runtime.is_main():  # ref classif.py:242-243
         logging.info(f"Time: {mins}m {secs}s, Acc: {acc * 100:.2f}%")
@@ -634,6 +744,15 @@ def run_test(cfg: Config) -> dict:
 
 def main(argv=None) -> int:
     cfg = config_from_argv(argv)
+    if cfg.action == "telemetry":
+        # Offline aggregation of RSL_PATH/telemetry/rank*.jsonl — no
+        # training banners, no JAX backend touched.
+        try:
+            print(telemetry.report(cfg.rsl_path))
+        except ValueError as e:
+            logging.error(f"{e}, exiting...")
+            return 1
+        return 0
     print("========================= start =========================")
     try:
         if cfg.action == "train":

@@ -1,0 +1,59 @@
+"""Version-compatibility shims for the jax API surface the kernels use.
+
+The framework is written against current jax — ``jax.shard_map`` (with
+``check_vma``) and ``jax.typeof``'s vma-typed avals — but deployment
+images carry a range of jaxlibs, and older ones still have shard_map in
+``jax.experimental`` (with the checker spelled ``check_rep``) and no vma
+typing at all.  XLA-level differences are probed the same way in
+``__graft_entry__`` (collective-timeout flags); the jax-level ones live
+here so kernel/model code keeps the modern spelling.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# Layout-invariant PRNG: the framework's determinism story (utils.root_key
+# fold_in streams feeding on-device augmentation) assumes random bits do
+# NOT depend on how the consuming computation is sharded — current jax
+# defaults to the partitionable threefry that guarantees this; older
+# versions default to the layout-dependent lowering, where e.g. a
+# model-parallel step draws different augmentation noise than the
+# replicated step (tests pin them equal).  Opt in explicitly so both
+# behave alike.
+try:
+    jax.config.update("jax_threefry_partitionable", True)
+except Exception:  # config retired (newer jax: always on)
+    pass
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` when available, else the jax.experimental one
+    (same semantics; the replication checker kwarg was named
+    ``check_rep`` there)."""
+    if hasattr(jax, "shard_map"):
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def vma_of(x) -> frozenset:
+    """Varying-manual-axes of ``x``'s aval; empty on jaxes without vma
+    typing (there the strict checker doesn't exist either, so nothing
+    needs declaring)."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
+    return frozenset(getattr(typeof(x), "vma", ()) or ())
+
+
+def out_struct(shape, dtype, vma: frozenset) -> jax.ShapeDtypeStruct:
+    """ShapeDtypeStruct carrying ``vma`` when non-empty (a non-empty set
+    can only come from a vma-typed jax, where the kwarg exists)."""
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)

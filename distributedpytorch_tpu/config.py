@@ -93,6 +93,11 @@ class Config:
     synthetic_fallback: bool = False
     resident_max_bytes: int = 512 * 1024 * 1024
     profile: bool = False                  # jax.profiler trace of one epoch
+    # Structured telemetry (telemetry.py): per-rank JSONL metrics under
+    # RSL_PATH/telemetry/ — epoch/dispatch spans, data-wait counters,
+    # checkpoint durations, throughput + MFU gauges.  Off by default:
+    # the disabled path does no file I/O and adds no per-step work.
+    telemetry: bool = False
     # Fuse K (train+valid) epochs into one XLA dispatch (resident mode
     # only).  K>1 amortizes dispatch latency; checkpoints are then written
     # per chunk instead of per epoch.  1 = exact reference cadence.
@@ -199,6 +204,12 @@ def _common_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--profile", action="store_true",
                    help="write a jax.profiler trace of the second epoch "
                         "to RSL_PATH/trace")
+    p.add_argument("--telemetry", action="store_true",
+                   help="emit structured JSONL telemetry (spans, "
+                        "data-wait/step timing, checkpoint durations, "
+                        "throughput + MFU) to RSL_PATH/telemetry/"
+                        "rank<N>.jsonl; summarize with "
+                        "'main.py telemetry --rsl_path DIR'")
     p.add_argument("--epochs-per-dispatch", type=int, default=1,
                    dest="epochsPerDispatch", metavar="K",
                    help="fuse K train+valid epochs per XLA dispatch "
@@ -282,11 +293,21 @@ def build_parser() -> argparse.ArgumentParser:
     p_test.add_argument("-f", "--file", metavar="file_path", type=str,
                         dest="checkpointFile", default=None, required=True,
                         help="model file")
+
+    # Offline aggregation — reads RSL_PATH/telemetry/rank*.jsonl written
+    # by a --telemetry run; needs none of the train/test flags.
+    p_rep = sub.add_parser(
+        "telemetry", help="summarize a run's telemetry JSONL files")
+    p_rep.add_argument("--rsl_path", type=str, default=RSL_PATH,
+                       help=f"run directory holding telemetry/ "
+                            f"(default: {RSL_PATH})")
     return parser
 
 
 def config_from_argv(argv=None) -> Config:
     args = build_parser().parse_args(argv)
+    if args.action == "telemetry":
+        return Config(action="telemetry", rsl_path=args.rsl_path)
     return Config(
         action=args.action,
         data_path=args.dataPath,
@@ -307,6 +328,7 @@ def config_from_argv(argv=None) -> Config:
         prefetch=args.prefetch,
         synthetic_fallback=args.syntheticFallback,
         profile=args.profile,
+        telemetry=args.telemetry,
         epochs_per_dispatch=args.epochsPerDispatch,
         grad_accum=args.gradAccum,
         ckpt_format=args.ckptFormat,

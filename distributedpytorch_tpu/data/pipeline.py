@@ -22,6 +22,7 @@ wraparound padding the sampler added to keep shapes static (see sampler.py).
 from __future__ import annotations
 
 import collections
+import time
 from typing import Iterator, Tuple
 
 import jax
@@ -30,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .datasets import Split
 from .sampler import ShardedSampler
+from .. import telemetry
 from ..runtime import DATA_AXIS
 
 
@@ -131,6 +133,13 @@ class ShardedLoader:
             for r in self.local_ranks
         ]
         self.batches_per_epoch = self.samplers[0].batches_per_epoch
+        # Prefetch-queue observability state (ADVICE #4): exists from
+        # construction so tests/bench can always read it; None until the
+        # first prefetching iteration, and thereafter it reflects ONLY
+        # the most recent ``epoch()`` generator (two interleaved
+        # iterations of the same loader clobber each other's view —
+        # don't do that; each epoch() call rebinds it).
+        self._queue = None
 
     def __len__(self) -> int:
         return self.batches_per_epoch
@@ -156,25 +165,78 @@ class ShardedLoader:
 
     def epoch(self, epoch: int) -> Iterator[Tuple[jax.Array, jax.Array,
                                                   jax.Array]]:
-        """Async-prefetched iterator over one epoch's sharded batches."""
+        """Async-prefetched iterator over one epoch's sharded batches.
+
+        With telemetry enabled (telemetry.py) the instrumented twin of
+        each loop runs instead, feeding four counters: ``data/wait_s``
+        (host time producing+enqueueing batches — the data-wait half of
+        the data-vs-compute split; device_put is async so this is pure
+        host work), ``data/batches``, ``data/starved_steps`` (consumer
+        found no lookahead in the queue: H2D could not overlap that
+        step), and ``data/queue_depth_sum`` (divide by batches for mean
+        depth).  The disabled path is the original loop, untouched — no
+        clock reads, no counter lookups per step.
+        """
         host_iter = self._host_batches(epoch)
+        tel = telemetry.get()
         if self.prefetch == 0:
-            for arrays in host_iter:
-                yield self._to_device(arrays)
-            return
+            if not tel.enabled:
+                for arrays in host_iter:
+                    yield self._to_device(arrays)
+                return
+            wait = tel.counter("data/wait_s")
+            batches = tel.counter("data/batches")
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    arrays = self._to_device(next(host_iter))
+                except StopIteration:
+                    return
+                wait.add(time.perf_counter() - t0)
+                batches.add(1)
+                yield arrays
         # Instance attribute (not a local) so tests/bench can assert the
         # overlap actually happens: in steady state the queue holds the
         # next batch(es) — already device_put, H2D in flight — while the
         # consumer computes on the previous one.
         queue = self._queue = collections.deque()
+        if not tel.enabled:
+            try:
+                while len(queue) < self.prefetch:
+                    queue.append(self._to_device(next(host_iter)))
+            except StopIteration:
+                pass
+            while queue:
+                yield queue.popleft()
+                try:
+                    queue.append(self._to_device(next(host_iter)))
+                except StopIteration:
+                    pass
+            return
+        wait = tel.counter("data/wait_s")
+        batches = tel.counter("data/batches")
+        starved = tel.counter("data/starved_steps")
+        depth_sum = tel.counter("data/queue_depth_sum")
+        exhausted = False
+        t0 = time.perf_counter()
         try:
             while len(queue) < self.prefetch:
                 queue.append(self._to_device(next(host_iter)))
         except StopIteration:
-            pass
+            exhausted = True
+        wait.add(time.perf_counter() - t0)
         while queue:
+            depth_sum.add(len(queue))
+            if len(queue) == 1 and not exhausted:
+                # handing out the last buffered batch with more data
+                # still to come: the next step's H2D has nothing in
+                # flight to hide behind
+                starved.add(1)
+            batches.add(1)
             yield queue.popleft()
+            t0 = time.perf_counter()
             try:
                 queue.append(self._to_device(next(host_iter)))
             except StopIteration:
-                pass
+                exhausted = True
+            wait.add(time.perf_counter() - t0)

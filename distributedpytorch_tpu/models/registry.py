@@ -93,6 +93,16 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
             raise ValueError(
                 "pallas_dw applies to the cnn model only (the "
                 "patch-reuse conv-dW kernel covers its 3x3/SAME convs)")
+        # Incompatible-feature validation BEFORE the early return
+        # (ADVICE #1): the vit-family flags below would otherwise be
+        # silently ignored instead of raising as the non-pallas path does.
+        if (moe_experts or attention != "full" or tensor_parallel
+                or pipeline_parallel):
+            raise ValueError(
+                "pallas_dw is exclusive with the vit-family features; got "
+                f"moe_experts={moe_experts}, attention={attention!r}, "
+                f"tensor_parallel={tensor_parallel}, "
+                f"pipeline_parallel={pipeline_parallel}")
         from .simple import SmallCNN
 
         return SmallCNN(num_classes=num_classes, dtype=dtype,

@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import compat
 from ..runtime import DATA_AXIS, MODEL_AXIS
 
 _LN_EPS = 1e-6
@@ -247,7 +248,7 @@ def make_pipeline_fn(mesh, n_stages: int, depth: int, heads: int,
             _pipeline_local, heads=heads, n_stages=n_stages,
             blocks_per_stage=blocks_per_stage, n_micro=n_micro,
             attn_fn=attn_fn)
-        out = jax.shard_map(
+        out = compat.shard_map(
             body, mesh=mesh,
             in_specs=(param_specs, data_spec),
             out_specs=data_spec)(stacked, tokens)

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import compat
+
 
 def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    causal: bool = False) -> jax.Array:
@@ -247,13 +249,13 @@ def _ring_jitted(mesh: Mesh, axis_name: str, n_dev: int, s_local: int,
         # (JAX's own error suggests this exact workaround).  Correctness
         # is pinned value-wise against full_attention in
         # tests/test_attention.py instead.
-        return jax.jit(jax.shard_map(
+        return jax.jit(compat.shard_map(
             fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False))
     fn = functools.partial(_ring_attention_local, axis_name=axis_name,
                            n_dev=n_dev, s_local=s_local, causal=causal,
                            kv_valid=kv_valid)
-    return jax.jit(jax.shard_map(
+    return jax.jit(compat.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
 
 

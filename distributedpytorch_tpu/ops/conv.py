@@ -64,6 +64,11 @@ _PATCH_VMEM_BUDGET = 4 * 1024 * 1024
 # working set compiles in seconds.
 _VMEM_LIMIT = 40 * 1024 * 1024
 
+# jax renamed pltpu.TPUCompilerParams -> CompilerParams; support both so
+# the kernel runs across the jaxlib versions the environments carry.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 
 def _use_interpret() -> bool:
     # Real Mosaic lowering on TPU; interpreter everywhere else (the CPU
@@ -71,12 +76,18 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _chunk(b: int, h: int, w: int, ci: int) -> int:
-    """Largest divisor of ``b`` whose patch buffer fits the budget."""
+def _chunk(b: int, h: int, w: int, ci: int, itemsize: int) -> int:
+    """Largest divisor of ``b`` whose patch buffer fits the budget.
+
+    ``itemsize`` is the element width of the kernel's compute dtype
+    (the scratch buffer is allocated in x.dtype): hardcoding 2
+    (ADVICE #2) doubled the real scratch size under float32
+    (half_precision=False), letting the chosen chunk push the working
+    set past the scoped-VMEM limit on a real TPU."""
     from ..utils import largest_divisor_leq
 
     return largest_divisor_leq(
-        b, max(1, _PATCH_VMEM_BUDGET // (h * w * 9 * ci * 2)))
+        b, max(1, _PATCH_VMEM_BUDGET // (h * w * 9 * ci * itemsize)))
 
 
 def _dw_kernel(xp_ref, dy_ref, out_ref, patch_ref):
@@ -116,7 +127,7 @@ def conv3x3_dw(x: jax.Array, dy: jax.Array) -> jax.Array:
     b, h, w, ci = x.shape
     co = dy.shape[-1]
     xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
-    bc = _chunk(b, h, w, ci)
+    bc = _chunk(b, h, w, ci, jnp.dtype(x.dtype).itemsize)
     out = pl.pallas_call(
         _dw_kernel,
         grid=(b // bc,),
@@ -127,7 +138,7 @@ def conv3x3_dw(x: jax.Array, dy: jax.Array) -> jax.Array:
         out_specs=pl.BlockSpec((9 * ci, co), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((9 * ci, co), jnp.float32),
         scratch_shapes=[pltpu.VMEM((bc, h, w, 9 * ci), x.dtype)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=_VMEM_LIMIT),
         interpret=_use_interpret(),

@@ -137,12 +137,12 @@ def _out_struct(shape, dtype, *join_of):
     """ShapeDtypeStruct for a pallas output; under shard_map (vma-typed
     inputs) the output's varying-manual-axes must be declared explicitly
     — it is the join of the inputs'."""
+    from .. import compat
+
     vma = frozenset()
     for x in join_of:
-        vma = vma | frozenset(getattr(jax.typeof(x), "vma", ()) or ())
-    if vma:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
-    return jax.ShapeDtypeStruct(shape, dtype)
+        vma = vma | compat.vma_of(x)
+    return compat.out_struct(shape, dtype, vma)
 
 
 def _pos_arrays(q_pos, k_pos, s: int):

@@ -15,7 +15,7 @@ framework-added observability, flagged as a divergence-by-addition.
 from __future__ import annotations
 
 import math
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -116,6 +116,28 @@ def train_flops_per_sample(model: Any, params: Any, batch_stats: Any,
     fwd = forward_flops(model, params, batch_stats, batch, input_size,
                         dtype)
     return 3.0 * fwd / batch
+
+
+# Published peak dense bf16 FLOP/s per chip, keyed by device_kind substring
+# (lowercased).  Unknown kinds (incl. CPU) report None — callers (bench.py,
+# the telemetry MFU gauge) then omit MFU rather than fabricate it.
+PEAK_BF16_FLOPS = [
+    ("v6e", 918e12), ("v6 lite", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12), ("v5 lite", 197e12), ("v5litepod", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def peak_flops(device_kind: str) -> Optional[float]:
+    """Peak dense bf16 FLOP/s for a ``Device.device_kind``, or None."""
+    kind = device_kind.lower()
+    for key, peak in PEAK_BF16_FLOPS:
+        if key in kind:
+            return peak
+    return None
 
 
 def human_flops(flops: float) -> str:

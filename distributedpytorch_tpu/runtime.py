@@ -28,6 +28,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import compat  # noqa: F401  (jax version shims, PRNG config)
+
 # Canonical mesh axis names.  Data parallelism ('data') is the reference's
 # one and only strategy (SURVEY §2 parallelism checklist); 'model' exists so
 # tensor-parallel shardings have a named axis to ride on; 'seq' is the

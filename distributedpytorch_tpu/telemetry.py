@@ -1,0 +1,458 @@
+"""L1: structured telemetry — per-rank JSONL metrics, spans, and reports.
+
+The reference's only observability is unstructured log lines (ref
+classif.py:171-178) and the reproduction was barely better: throughput/MFU
+numbers existed only inside bench.py, and the one jax.profiler trace
+(--profile) had nothing machine-readable to line up against.  This module
+is the missing layer: a process-local metrics registry plus a ``span``
+context manager that emit machine-readable JSONL events to
+``RSL_PATH/telemetry/rank<process_index>.jsonl`` — one file per process,
+no cross-host coordination, so multi-host runs get straggler visibility
+by simply aggregating the files afterwards (``aggregate``/``render_report``
+below, surfaced as the ``telemetry`` CLI subcommand).
+
+Zero-cost when disabled: ``get()`` returns a module-level singleton that
+is a no-op ``Telemetry(enabled=False)`` until ``configure()`` swaps in an
+enabled one; hot paths guard their instrumentation on ``tel.enabled`` so
+the off state adds no per-step work (acceptance criterion).  Events are
+buffered and flushed at epoch/close boundaries — the hot loop never does
+file I/O.
+
+Event schema (one JSON object per line; every line carries ``ts`` —
+epoch seconds — and ``rank``):
+
+  kind="span"       name, dur_s, parent (enclosing span name or null),
+                    attrs (span-specific: epoch, step count, path, ...)
+  kind="counter"    name, value       (monotonic total, emitted at flush)
+  kind="gauge"      name, value, attrs (emitted on every set)
+  kind="histogram"  name, count, sum, min, max, mean, p50, p90, p99
+                    (summary, emitted at flush)
+  kind="event"      name, attrs       (point events: preemption, meta)
+
+Span names used by the framework (the report groups on these):
+  epoch, train_pass, eval_pass, train_dispatch, train_step, eval_step,
+  chunk_dispatch, ckpt_save, ckpt_restore.
+Counter/gauge names:
+  data/wait_s, data/batches, data/starved_steps, data/queue_depth_sum,
+  throughput/samples_per_sec_per_chip, throughput/mfu.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional
+
+_FLUSH_EVERY = 1024  # buffered events before an automatic flush
+
+
+class Counter:
+    """Monotonic accumulator; summarized as one event at flush time."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+
+    def add(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+
+class Gauge:
+    """Last-value metric; every ``set`` emits an event (time series)."""
+
+    __slots__ = ("name", "value", "_tel")
+
+    def __init__(self, name: str, tel: "Telemetry"):
+        self.name = name
+        self.value: Optional[float] = None
+        self._tel = tel
+
+    def set(self, value: Optional[float], **attrs: Any) -> None:
+        """``None`` is a recorded null — the event documents the gauge
+        was considered but unavailable (e.g. MFU on an unknown chip)."""
+        self.value = None if value is None else float(value)
+        self._tel._emit({"kind": "gauge", "name": self.name,
+                         "value": self.value,
+                         **({"attrs": attrs} if attrs else {})})
+
+
+class Histogram:
+    """Timing histogram: stores observations (bounded), summarized at
+    flush with count/sum/min/max/mean and p50/p90/p99."""
+
+    __slots__ = ("name", "count", "sum", "min", "max", "_samples")
+
+    MAX_SAMPLES = 4096  # bounds memory on long runs; quantiles from these
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self._samples: List[float] = []
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.sum += value
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+        if len(self._samples) < self.MAX_SAMPLES:
+            self._samples.append(value)
+
+    def summary(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"count": self.count, "sum": self.sum}
+        if not self.count:
+            return out
+        out.update(min=self.min, max=self.max, mean=self.sum / self.count)
+        s = sorted(self._samples)
+        for q, label in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+            out[label] = s[min(len(s) - 1, int(q * len(s)))]
+        return out
+
+
+class _Span:
+    """Context manager recording one timed span; nests via a per-instance
+    stack so the event carries its parent's name."""
+
+    __slots__ = ("_tel", "name", "attrs", "_start", "_parent")
+
+    def __init__(self, tel: "Telemetry", name: str, attrs: Dict[str, Any]):
+        self._tel = tel
+        self.name = name
+        self.attrs = attrs
+        self._start = 0.0
+        self._parent: Optional[str] = None
+
+    def __enter__(self) -> "_Span":
+        stack = self._tel._span_stack
+        self._parent = stack[-1] if stack else None
+        stack.append(self.name)
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        dur = time.perf_counter() - self._start
+        stack = self._tel._span_stack
+        if stack and stack[-1] == self.name:
+            stack.pop()
+        self._tel._emit({"kind": "span", "name": self.name,
+                         "dur_s": dur, "parent": self._parent,
+                         **({"attrs": self.attrs} if self.attrs else {})})
+        return False
+
+
+class _NullSpan:
+    """The disabled span: nothing measured, nothing emitted."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Telemetry:
+    """Process-local registry + JSONL sink.
+
+    One instance per process; the file is ``telemetry/rank<N>.jsonl``
+    under the run's RSL_PATH.  Disabled instances never touch the
+    filesystem: every method is a cheap no-op.
+    """
+
+    def __init__(self, enabled: bool = False, rsl_path: str = ".",
+                 rank: int = 0):
+        self.enabled = enabled
+        self.rank = rank
+        self._dir = os.path.join(rsl_path, "telemetry")
+        self._path = os.path.join(self._dir, f"rank{rank}.jsonl")
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._span_stack: List[str] = []
+        self._buffer: List[str] = []
+        self._lock = threading.Lock()
+        self._file = None
+
+    # -- registry -----------------------------------------------------
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            c = self._counters[name] = Counter(name)
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            g = self._gauges[name] = Gauge(name, self)
+        return g
+
+    def histogram(self, name: str) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            h = self._histograms[name] = Histogram(name)
+        return h
+
+    def span(self, name: str, **attrs: Any):
+        """Timed context manager; emits a span event on exit.  The
+        disabled instance returns a shared no-op (no clock reads)."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, attrs)
+
+    def event(self, name: str, **attrs: Any) -> None:
+        """Point event (preemption, run metadata, ...)."""
+        self._emit({"kind": "event", "name": name,
+                    **({"attrs": attrs} if attrs else {})})
+
+    # -- sink ---------------------------------------------------------
+
+    def _emit(self, payload: Dict[str, Any]) -> None:
+        if not self.enabled:
+            return
+        payload["ts"] = time.time()
+        payload["rank"] = self.rank
+        line = json.dumps(payload, sort_keys=True, default=float)
+        with self._lock:
+            self._buffer.append(line)
+            if len(self._buffer) >= _FLUSH_EVERY:
+                self._write_locked()
+
+    def _write_locked(self) -> None:
+        if not self._buffer:
+            return
+        if self._file is None:
+            os.makedirs(self._dir, exist_ok=True)
+            self._file = open(self._path, "a", encoding="utf-8")
+        self._file.write("\n".join(self._buffer) + "\n")
+        self._file.flush()
+        self._buffer.clear()
+
+    def flush(self) -> None:
+        """Write buffered events to disk (epoch boundaries; cheap when
+        nothing is pending)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._write_locked()
+
+    def close(self) -> None:
+        """Emit counter/histogram summaries, flush, close the file.
+        Idempotent: the instance is disabled afterwards, so a second
+        close (or a late emit) is a no-op rather than a duplicate
+        summary block."""
+        if not self.enabled:
+            return
+        for c in self._counters.values():
+            self._emit({"kind": "counter", "name": c.name,
+                        "value": c.value})
+        for h in self._histograms.values():
+            self._emit({"kind": "histogram", "name": h.name, **h.summary()})
+        with self._lock:
+            self._write_locked()
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+        self.enabled = False
+
+
+_active = Telemetry(enabled=False)
+
+
+def get() -> Telemetry:
+    """The process's active telemetry (a disabled no-op by default)."""
+    return _active
+
+
+def configure(rsl_path: str, enabled: bool, rank: Optional[int] = None
+              ) -> Telemetry:
+    """Install the process's telemetry instance (drivers call this once,
+    after runtime init so the rank is the GLOBAL process index).  A
+    previous enabled instance is closed first — re-invocation safe, same
+    convention as utils.initialize_logging."""
+    global _active
+    if _active.enabled:
+        _active.close()
+    if rank is None:
+        try:
+            import jax
+
+            rank = jax.process_index()
+        except Exception:
+            rank = 0
+    _active = Telemetry(enabled=enabled, rsl_path=rsl_path, rank=rank)
+    return _active
+
+
+# -- report: aggregate per-rank JSONL into a human-readable summary ----
+
+
+def load_events(telemetry_dir: str) -> List[Dict[str, Any]]:
+    """All events from every ``rank*.jsonl`` under ``telemetry_dir``.
+    Lines that fail to parse are skipped (a run killed mid-write leaves
+    at most one torn last line per file)."""
+    events: List[Dict[str, Any]] = []
+    try:
+        names = sorted(os.listdir(telemetry_dir))
+    except OSError as e:
+        raise ValueError(
+            f"no telemetry directory at {telemetry_dir!r} "
+            f"({e.strerror or e}); run with --telemetry first") from e
+    for fn in names:
+        if not (fn.startswith("rank") and fn.endswith(".jsonl")):
+            continue
+        with open(os.path.join(telemetry_dir, fn), encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(ev, dict):
+                    events.append(ev)
+    if not events:
+        raise ValueError(f"no telemetry events under {telemetry_dir!r}")
+    return events
+
+
+def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Cross-rank aggregation: span stats by name, per-rank epoch means
+    (straggler view), counter totals, latest gauges, starvation fraction.
+    Pure data-in/data-out so tests (and notebooks) can assert on it."""
+    spans: Dict[str, Dict[str, Any]] = {}
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, Dict[int, float]] = {}
+    histograms: Dict[str, List[Dict[str, Any]]] = {}
+    point_events: List[Dict[str, Any]] = []
+    rank_epoch: Dict[int, List[float]] = {}
+    ranks = set()
+    for ev in events:
+        rank = int(ev.get("rank", 0))
+        ranks.add(rank)
+        kind, name = ev.get("kind"), ev.get("name")
+        if kind == "span":
+            s = spans.setdefault(name, {"count": 0, "total_s": 0.0,
+                                        "max_s": 0.0})
+            dur = float(ev.get("dur_s", 0.0))
+            s["count"] += 1
+            s["total_s"] += dur
+            s["max_s"] = max(s["max_s"], dur)
+            if name == "epoch":
+                rank_epoch.setdefault(rank, []).append(dur)
+        elif kind == "counter":
+            counters[name] = counters.get(name, 0.0) \
+                + float(ev.get("value", 0.0))
+        elif kind == "gauge":
+            if ev.get("value") is not None:  # null = recorded-unavailable
+                gauges.setdefault(name, {})[rank] = float(ev["value"])
+        elif kind == "histogram":
+            histograms.setdefault(name, []).append(ev)
+        elif kind == "event":
+            point_events.append(ev)
+    for s in spans.values():
+        s["mean_s"] = s["total_s"] / max(s["count"], 1)
+
+    # Data-starvation fraction: host time blocked waiting on batches as a
+    # share of the train passes it stalled (both from the same rank set).
+    train_total = (spans.get("train_pass", {}).get("total_s", 0.0)
+                   or spans.get("train_dispatch", {}).get("total_s", 0.0))
+    wait = counters.get("data/wait_s", 0.0)
+    starvation = wait / train_total if train_total > 0 else None
+
+    return {
+        "ranks": sorted(ranks),
+        "spans": spans,
+        "counters": counters,
+        "gauges": {name: {"latest_per_rank": per,
+                          "mean": sum(per.values()) / len(per)}
+                   for name, per in gauges.items()},
+        "histograms": histograms,
+        "events": point_events,
+        "epoch_s_per_rank": {r: sum(v) / len(v)
+                             for r, v in rank_epoch.items()},
+        "data_starvation_fraction": starvation,
+    }
+
+
+def render_report(agg: Dict[str, Any]) -> str:
+    """The human-readable summary the ``telemetry`` subcommand prints."""
+    lines = []
+    lines.append(f"telemetry report — {len(agg['ranks'])} rank(s): "
+                 f"{agg['ranks']}")
+
+    spans = agg["spans"]
+    if spans:
+        lines.append("")
+        lines.append("slowest spans (by total time):")
+        lines.append(f"  {'span':<16} {'count':>6} {'total_s':>10} "
+                     f"{'mean_s':>10} {'max_s':>10}")
+        for name, s in sorted(spans.items(),
+                              key=lambda kv: -kv[1]["total_s"]):
+            lines.append(f"  {name:<16} {s['count']:>6} "
+                         f"{s['total_s']:>10.3f} {s['mean_s']:>10.3f} "
+                         f"{s['max_s']:>10.3f}")
+
+    per_rank = agg["epoch_s_per_rank"]
+    if len(per_rank) > 1:
+        slowest = max(per_rank, key=per_rank.get)
+        fastest = min(per_rank, key=per_rank.get)
+        lines.append("")
+        lines.append("stragglers (mean epoch seconds per rank):")
+        for r in sorted(per_rank):
+            tag = (" <- slowest" if r == slowest else
+                   " <- fastest" if r == fastest else "")
+            lines.append(f"  rank {r}: {per_rank[r]:.3f}s{tag}")
+
+    frac = agg["data_starvation_fraction"]
+    if frac is not None:
+        lines.append("")
+        lines.append(f"data starvation: {frac * 100:.1f}% of train time "
+                     f"spent waiting on batches "
+                     f"({agg['counters'].get('data/wait_s', 0.0):.3f}s)")
+    starved = agg["counters"].get("data/starved_steps")
+    batches = agg["counters"].get("data/batches")
+    if starved is not None and batches:
+        lines.append(f"prefetch: {int(starved)}/{int(batches)} steps found "
+                     f"the queue empty")
+
+    gauges = agg["gauges"]
+    tput = gauges.get("throughput/samples_per_sec_per_chip")
+    if tput:
+        lines.append("")
+        lines.append(f"throughput: {tput['mean']:,.0f} samples/s/chip "
+                     f"(latest per rank: "
+                     f"{ {r: round(v, 1) for r, v in sorted(tput['latest_per_rank'].items()) } })")
+    mfu = gauges.get("throughput/mfu")
+    if mfu:
+        lines.append(f"MFU: {mfu['mean'] * 100:.1f}%")
+
+    ckpt = {n: s for n, s in spans.items()
+            if n in ("ckpt_save", "ckpt_restore")}
+    for name, s in sorted(ckpt.items()):
+        lines.append(f"{name}: {s['count']}x, total {s['total_s']:.3f}s, "
+                     f"mean {s['mean_s']:.3f}s")
+
+    preempts = [e for e in agg["events"] if e.get("name") == "preempt"]
+    if preempts:
+        lines.append(f"preemption events: {len(preempts)}")
+    return "\n".join(lines)
+
+
+def report(rsl_path: str) -> str:
+    """Load + aggregate + render for a run directory (CLI entry)."""
+    return render_report(aggregate(load_events(
+        os.path.join(rsl_path, "telemetry"))))

@@ -81,6 +81,12 @@ class GracefulShutdown:
             signal.raise_signal(signum)
             return
         self.requested = True
+        # Telemetry point event, buffered (no file I/O in the handler);
+        # the epoch-boundary flush or close() writes it out, so even a
+        # preempted run's JSONL records when the signal landed.
+        from . import telemetry
+
+        telemetry.get().event("preempt_signal", signum=int(signum))
         logging.warning(
             f"received signal {signum}: finishing the current epoch, "
             "then checkpointing and exiting (repeat to abort immediately)")

@@ -1,0 +1,39 @@
+#!/usr/bin/env python3
+"""Aggregate a run's per-rank telemetry JSONL into a summary.
+
+Thin wrapper over the ``telemetry`` CLI subcommand (both call
+``distributedpytorch_tpu.telemetry.report``), kept as a standalone script
+so report generation needs no JAX backend and works on a results
+directory copied off the TPU host:
+
+    python scripts/telemetry_report.py --rsl_path ./rsl
+    python main.py telemetry --rsl_path ./rsl          # equivalent
+
+Prints slowest spans, per-rank straggler view, data-starvation fraction,
+prefetch-queue stats, samples/s/chip, MFU, and checkpoint durations.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from distributedpytorch_tpu import telemetry  # noqa: E402
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--rsl_path", type=str, default="./rsl",
+                   help="run directory holding telemetry/ (default ./rsl)")
+    args = p.parse_args()
+    try:
+        print(telemetry.report(args.rsl_path))
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,13 +58,17 @@ def test_dw_kernel_direct():
 
 
 def test_chunking_respects_budget_and_divides():
-    for b in (1, 6, 64, 512):
-        bc = conv_mod._chunk(b, 28, 28, 32)
-        assert b % bc == 0
-        assert bc * 28 * 28 * 9 * 32 * 2 <= conv_mod._PATCH_VMEM_BUDGET \
-            or bc == 1
+    # budget is honored for the ACTUAL element width (ADVICE #2): bf16
+    # and f32 chunks both fit, and f32 chunks are no larger than bf16's
+    for itemsize in (2, 4):
+        for b in (1, 6, 64, 512):
+            bc = conv_mod._chunk(b, 28, 28, 32, itemsize)
+            assert b % bc == 0
+            assert (bc * 28 * 28 * 9 * 32 * itemsize
+                    <= conv_mod._PATCH_VMEM_BUDGET) or bc == 1
+            assert bc <= conv_mod._chunk(b, 28, 28, 32, 2)
     # big batch on the small feature map still fits
-    assert conv_mod._chunk(512, 14, 14, 64) >= 1
+    assert conv_mod._chunk(512, 14, 14, 64, 4) >= 1
 
 
 def test_smallcnn_flag_same_tree_and_close_grads():

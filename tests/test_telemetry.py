@@ -1,0 +1,278 @@
+"""Telemetry subsystem (telemetry.py): registry semantics, span nesting
+and JSONL schema, pipeline data-wait counters, multi-rank report
+aggregation, and the driver-level --telemetry contract — all CPU-only on
+the 8-device virtual mesh (tier-1)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu import telemetry
+
+
+@pytest.fixture
+def restore_global():
+    """Tests that install a global instance must not leak an enabled one
+    into the rest of the suite."""
+    yield
+    telemetry._active = telemetry.Telemetry(enabled=False)
+
+
+def _read_events(path):
+    with open(path, encoding="utf-8") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# -- registry semantics ------------------------------------------------
+
+
+def test_counter_gauge_histogram_semantics(tmp_path):
+    tel = telemetry.Telemetry(enabled=True, rsl_path=str(tmp_path), rank=3)
+    c = tel.counter("c")
+    c.add()
+    c.add(2.5)
+    assert tel.counter("c") is c  # registry returns the same instance
+    assert c.value == 3.5
+
+    h = tel.histogram("h")
+    for v in range(100):
+        h.observe(float(v))
+    s = h.summary()
+    assert s["count"] == 100 and s["min"] == 0.0 and s["max"] == 99.0
+    assert s["mean"] == pytest.approx(49.5)
+    assert s["p50"] == pytest.approx(50.0, abs=2)
+    assert s["p99"] == pytest.approx(99.0, abs=2)
+
+    tel.gauge("g").set(1.25, epoch=7)
+    tel.close()
+    events = _read_events(tmp_path / "telemetry" / "rank3.jsonl")
+    by_kind = {(e["kind"], e["name"]): e for e in events}
+    assert by_kind[("counter", "c")]["value"] == 3.5
+    assert by_kind[("gauge", "g")]["value"] == 1.25
+    assert by_kind[("gauge", "g")]["attrs"] == {"epoch": 7}
+    assert by_kind[("histogram", "h")]["count"] == 100
+    # every line carries the rank and a timestamp
+    assert all(e["rank"] == 3 and e["ts"] > 0 for e in events)
+
+
+def test_disabled_instance_does_no_file_io(tmp_path):
+    tel = telemetry.Telemetry(enabled=False, rsl_path=str(tmp_path))
+    tel.counter("c").add()
+    tel.gauge("g").set(1.0)
+    tel.histogram("h").observe(0.1)
+    with tel.span("s"):
+        pass
+    tel.event("e")
+    tel.flush()
+    tel.close()
+    assert not os.path.exists(tmp_path / "telemetry")
+    # and the span is the shared no-op (no per-call allocation)
+    assert tel.span("a") is tel.span("b")
+
+
+def test_close_is_idempotent(tmp_path):
+    tel = telemetry.Telemetry(enabled=True, rsl_path=str(tmp_path), rank=0)
+    tel.counter("c").add(1)
+    tel.close()
+    tel.close()  # second close: no duplicate summary block
+    events = _read_events(tmp_path / "telemetry" / "rank0.jsonl")
+    assert sum(1 for e in events if e["kind"] == "counter") == 1
+
+
+def test_gauge_null_is_recorded_and_skipped_by_aggregate(tmp_path):
+    tel = telemetry.Telemetry(enabled=True, rsl_path=str(tmp_path), rank=0)
+    tel.gauge("throughput/mfu").set(None, reason="unknown_peak")
+    tel.close()
+    events = _read_events(tmp_path / "telemetry" / "rank0.jsonl")
+    assert events[0]["value"] is None
+    agg = telemetry.aggregate(events)
+    assert "throughput/mfu" not in agg["gauges"]
+
+
+# -- span nesting + JSONL schema round-trip ----------------------------
+
+
+def test_span_nesting_and_schema_roundtrip(tmp_path):
+    tel = telemetry.Telemetry(enabled=True, rsl_path=str(tmp_path), rank=1)
+    with tel.span("outer", epoch=0):
+        with tel.span("inner", step=4):
+            pass
+    tel.close()
+    events = _read_events(tmp_path / "telemetry" / "rank1.jsonl")
+    spans = {e["name"]: e for e in events if e["kind"] == "span"}
+    assert spans["inner"]["parent"] == "outer"
+    assert spans["outer"]["parent"] is None
+    # inner closed first, and durations nest
+    assert spans["inner"]["dur_s"] <= spans["outer"]["dur_s"]
+    assert spans["inner"]["attrs"] == {"step": 4}
+    # the aggregate of a round-tripped file sees both spans
+    agg = telemetry.aggregate(events)
+    assert agg["spans"]["outer"]["count"] == 1
+    assert agg["spans"]["inner"]["count"] == 1
+
+
+def test_configure_swaps_the_global(tmp_path, restore_global):
+    tel = telemetry.configure(str(tmp_path), enabled=True, rank=0)
+    assert telemetry.get() is tel and tel.enabled
+    tel2 = telemetry.configure(str(tmp_path), enabled=False)
+    assert telemetry.get() is tel2 and not tel2.enabled
+    # the first instance was closed by the swap
+    assert not tel.enabled
+
+
+# -- pipeline data-wait counters on a synthetic loader -----------------
+
+
+def _small_loader(prefetch):
+    from distributedpytorch_tpu import runtime
+    from distributedpytorch_tpu.data.datasets import Split
+    from distributedpytorch_tpu.data.io import make_synthetic
+    from distributedpytorch_tpu.data.pipeline import ShardedLoader
+
+    tr_x, tr_y, _, _ = make_synthetic(num_train=64, num_test=8,
+                                      image_size=28, channels=1, seed=0)
+    mesh = runtime.make_mesh()
+    return ShardedLoader(Split(tr_x, tr_y), mesh, batch_per_replica=2,
+                         shuffle=False, seed=0, prefetch=prefetch)
+
+
+@pytest.mark.parametrize("prefetch", [0, 2])
+def test_pipeline_data_wait_counters(tmp_path, restore_global, prefetch):
+    loader = _small_loader(prefetch)
+    assert loader._queue is None  # exists before the first iteration
+    tel = telemetry.configure(str(tmp_path), enabled=True, rank=0)
+    n = sum(1 for _ in loader.epoch(0))
+    assert n == len(loader)
+    assert tel.counter("data/batches").value == n
+    assert tel.counter("data/wait_s").value > 0
+    if prefetch > 0:
+        assert loader._queue is not None  # latest epoch iterator's queue
+        # depth was sampled once per yielded batch
+        assert tel.counter("data/queue_depth_sum").value >= n
+        assert 0 <= tel.counter("data/starved_steps").value <= n
+
+
+def test_pipeline_disabled_keeps_counters_at_zero(restore_global):
+    loader = _small_loader(2)
+    tel = telemetry.get()
+    assert not tel.enabled
+    n = sum(1 for _ in loader.epoch(0))
+    assert n == len(loader)
+    assert tel.counter("data/batches").value == 0  # nothing was counted
+
+
+# -- report aggregation over multi-rank fixture files ------------------
+
+
+def _write_rank_fixture(d, rank, epoch_s, wait_s):
+    lines = []
+    for epoch, dur in enumerate(epoch_s):
+        lines.append({"kind": "span", "name": "epoch", "dur_s": dur,
+                      "parent": None, "attrs": {"epoch": epoch},
+                      "ts": 1000.0 + epoch, "rank": rank})
+        lines.append({"kind": "span", "name": "train_pass",
+                      "dur_s": dur * 0.8, "parent": "epoch",
+                      "ts": 1000.0 + epoch, "rank": rank})
+    lines.append({"kind": "counter", "name": "data/wait_s",
+                  "value": wait_s, "ts": 1010.0, "rank": rank})
+    lines.append({"kind": "counter", "name": "data/batches",
+                  "value": 8, "ts": 1010.0, "rank": rank})
+    lines.append({"kind": "counter", "name": "data/starved_steps",
+                  "value": 2, "ts": 1010.0, "rank": rank})
+    lines.append({"kind": "gauge",
+                  "name": "throughput/samples_per_sec_per_chip",
+                  "value": 1000.0 + rank, "ts": 1010.0, "rank": rank})
+    lines.append({"kind": "gauge", "name": "throughput/mfu",
+                  "value": 0.4 + 0.1 * rank, "ts": 1010.0, "rank": rank})
+    with open(os.path.join(d, f"rank{rank}.jsonl"), "w") as f:
+        f.write("\n".join(json.dumps(x) for x in lines) + "\n")
+
+
+def test_report_aggregates_multi_rank_files(tmp_path):
+    d = str(tmp_path / "telemetry")
+    os.makedirs(d)
+    _write_rank_fixture(d, 0, epoch_s=[1.0, 1.2], wait_s=0.2)
+    _write_rank_fixture(d, 1, epoch_s=[2.0, 2.2], wait_s=0.9)
+    agg = telemetry.aggregate(telemetry.load_events(d))
+    assert agg["ranks"] == [0, 1]
+    assert agg["spans"]["epoch"]["count"] == 4
+    assert agg["spans"]["epoch"]["max_s"] == pytest.approx(2.2)
+    # straggler view: rank 1 is ~2x slower
+    assert agg["epoch_s_per_rank"][1] > agg["epoch_s_per_rank"][0]
+    # starvation fraction = total wait / total train_pass time
+    total_train = (1.0 + 1.2 + 2.0 + 2.2) * 0.8
+    assert agg["data_starvation_fraction"] == pytest.approx(
+        1.1 / total_train)
+    assert agg["gauges"]["throughput/mfu"]["mean"] == pytest.approx(0.45)
+
+    report = telemetry.render_report(agg)
+    assert "slowest spans" in report
+    assert "rank 1" in report and "slowest" in report
+    assert "data starvation" in report
+    assert "MFU: 45.0%" in report
+    # torn last line (killed mid-write) is skipped, not fatal
+    with open(os.path.join(d, "rank0.jsonl"), "a") as f:
+        f.write('{"kind": "span", "na')
+    telemetry.aggregate(telemetry.load_events(d))
+
+
+def test_report_errors_without_telemetry_dir(tmp_path):
+    with pytest.raises(ValueError, match="telemetry"):
+        telemetry.report(str(tmp_path / "nope"))
+
+
+# -- driver-level contract (acceptance criterion) ----------------------
+
+
+def test_train_with_telemetry_writes_rank0_jsonl(tmp_path, restore_global):
+    from distributedpytorch_tpu.cli import run_train
+    from distributedpytorch_tpu.config import Config
+
+    rsl = str(tmp_path / "rsl")
+    cfg = Config(action="train", data_path="/tmp/nodata", rsl_path=rsl,
+                 dataset="synthetic", model_name="mlp", batch_size=8,
+                 nb_epochs=1, debug=True, half_precision=False,
+                 telemetry=True, data_mode="stream")
+    run_train(cfg)
+    path = os.path.join(rsl, "telemetry", "rank0.jsonl")
+    assert os.path.exists(path)
+    events = _read_events(path)
+    names = {(e["kind"], e["name"]) for e in events}
+    assert ("span", "epoch") in names
+    assert ("span", "train_pass") in names
+    assert ("span", "eval_pass") in names
+    assert ("span", "ckpt_save") in names
+    assert ("counter", "data/wait_s") in names
+    assert ("histogram", "step/dispatch_s") in names
+    assert ("gauge", "throughput/samples_per_sec_per_chip") in names
+    assert ("gauge", "throughput/mfu") in names  # recorded null on CPU
+    assert ("event", "run_start") in names
+    # the report renders from the real run's files
+    report = telemetry.report(rsl)
+    assert "slowest spans" in report and "epoch" in report
+    sps = [e for e in events
+           if e["name"] == "throughput/samples_per_sec_per_chip"]
+    assert all(np.isfinite(e["value"]) and e["value"] > 0 for e in sps)
+
+
+def test_train_without_telemetry_writes_nothing(tmp_path, restore_global):
+    from distributedpytorch_tpu.cli import run_train
+    from distributedpytorch_tpu.config import Config
+
+    rsl = str(tmp_path / "rsl")
+    run_train(Config(action="train", data_path="/tmp/nodata", rsl_path=rsl,
+                     dataset="synthetic", model_name="mlp", batch_size=8,
+                     nb_epochs=1, debug=True, half_precision=False))
+    assert not os.path.exists(os.path.join(rsl, "telemetry"))
+
+
+def test_telemetry_cli_flag_and_subcommand_roundtrip():
+    from distributedpytorch_tpu.config import config_from_argv
+
+    cfg = config_from_argv(["train", "-d", "/x", "--telemetry"])
+    assert cfg.telemetry
+    assert not config_from_argv(["train", "-d", "/x"]).telemetry
+    rep = config_from_argv(["telemetry", "--rsl_path", "/some/dir"])
+    assert rep.action == "telemetry" and rep.rsl_path == "/some/dir"
