@@ -217,12 +217,16 @@ def bench_ours(batch_per_replica: int, steps: int, model_name: str,
 
 
 def bench_ours_streaming(batch_per_replica: int, model_name: str = "cnn",
-                         epochs: int = 2) -> dict:
+                         epochs: int = 2,
+                         producer_threads: int = 0) -> dict:
     """The STREAMING data path (ShardedLoader: host index-gather +
     prefetched async device_put per step, engine.train_step dispatch per
     step) on the same corpus as the resident headline — quantifying the
     host-loop cost the resident design avoids (BENCH_SUITE row
-    cnn_b64_stream vs cnn_b64)."""
+    cnn_b64_stream vs cnn_b64).  ``producer_threads > 0`` measures the
+    threaded host pipeline (--producer-threads): the same loop with the
+    gather + device_put dispatch overlapped behind compute (row
+    cnn_b64_stream_threaded)."""
     import jax
 
     from distributedpytorch_tpu import runtime, utils
@@ -235,7 +239,8 @@ def bench_ours_streaming(batch_per_replica: int, model_name: str = "cnn",
     n_chips = runtime.world_size()
     dataset = _make_corpus(28, 1, 60000)
     loader = ShardedLoader(dataset.splits["train"], mesh, batch_per_replica,
-                           shuffle=True, seed=1234, prefetch=2)
+                           shuffle=True, seed=1234, prefetch=2,
+                           producer_threads=producer_threads)
     model = get_model(model_name, dataset.nb_classes)
     tx = make_optimizer("adam", 1e-3, 0.9, 0.1, len(loader), False)
     engine = Engine(model, model_name, get_loss_fn("cross_entropy"), tx,
@@ -300,7 +305,8 @@ def bench_ours_streaming(batch_per_replica: int, model_name: str = "cnn",
     t_disp = (time.monotonic() - t0) / 20
 
     out = {"model": model_name, "batch_per_replica": batch_per_replica,
-           "mode": "streaming", "samples_per_sec": sps,
+           "mode": "streaming", "producer_threads": producer_threads,
+           "samples_per_sec": sps,
            "samples_per_sec_per_chip": sps / n_chips, "n_chips": n_chips,
            "steps": epochs * len(loader), "elapsed_s": elapsed,
            "device_kind": jax.devices()[0].device_kind,
@@ -403,6 +409,11 @@ def run_suite(args) -> dict:
     # same corpus/model through the streaming loader: the host-loop cost
     # the resident design avoids, measured (VERDICT r2 item #7)
     rows["cnn_b64_stream"] = bench_ours_streaming(64, "cnn")
+    # the threaded host pipeline (--producer-threads 1): gather +
+    # device_put dispatch overlapped behind compute — the PR-2 overlap
+    # win on the same loop, measured against the row above
+    rows["cnn_b64_stream_threaded"] = bench_ours_streaming(
+        64, "cnn", producer_threads=1)
     rows["cnn_b512"] = bench_ours(512, args.steps, "cnn")
     rows["mlp_b64"] = bench_ours(64, args.steps, "mlp")
     # the attention model family (framework addition; models/vit.py)
@@ -784,6 +795,10 @@ def _fallback_headline() -> dict | None:
         return {"metric": "mnist_cnn_train_samples_per_sec_per_chip",
                 "value": round(row["samples_per_sec_per_chip"], 1),
                 "unit": "samples/s/chip",
+                # Machine-readable provenance (VERDICT r5 weak #1):
+                # consumers gate on this flag, not the error prose.  A
+                # replayed measurement must NEVER carry vs_baseline.
+                "fresh": False,
                 "vs_baseline": None,
                 "mfu": (round(row["mfu"], 4) if row.get("mfu")
                         else None),
@@ -830,8 +845,8 @@ def main() -> int:
         if fallback is None:
             fallback = {"metric": "mnist_cnn_train_samples_per_sec_per_"
                                   "chip", "value": None,
-                        "unit": "samples/s/chip", "vs_baseline": None,
-                        "mfu": None,
+                        "unit": "samples/s/chip", "fresh": False,
+                        "vs_baseline": None, "mfu": None,
                         "error": "TPU backend unavailable at run time"}
         print(json.dumps(fallback), flush=True)
         return 0
@@ -899,6 +914,10 @@ def main() -> int:
         "metric": "mnist_cnn_train_samples_per_sec_per_chip",
         "value": round(value, 1),
         "unit": "samples/s/chip",
+        # provenance flag (VERDICT r5 weak #1): this row was MEASURED in
+        # this process; replayed fallbacks carry fresh=false and a null
+        # vs_baseline (scripts/check_bench.py gates on it)
+        "fresh": True,
         "vs_baseline": round(vs, 2) if vs is not None else None,
         "mfu": (round(ours["mfu"], 4) if ours.get("mfu") else None),
     }), flush=True)

@@ -41,8 +41,10 @@ from __future__ import annotations
 import json
 import logging
 import os
+import queue as queue_mod
 import shutil
-from typing import Optional, Tuple
+import threading
+from typing import Callable, Optional, Tuple
 
 import jax
 import numpy as np
@@ -104,6 +106,33 @@ def best_model_path(rsl_path: str, dataset: str, model_name: str) -> str:
     return os.path.join(rsl_path, f"bestmodel-{dataset}-{model_name}.ckpt")
 
 
+def _msgpack_payload(model_name: str, state: TrainState, epoch: int,
+                     best_valid_loss: float) -> dict:
+    """The host-side snapshot: everything the file needs, with no live
+    device buffers left in it (donation-safe once this returns)."""
+    return {
+        "format_version": _FORMAT_VERSION,
+        "model_name": model_name,
+        "epoch": int(epoch),
+        "loss": float(best_valid_loss),
+        "state": serialization.to_state_dict(
+            jax.device_get(gather_replicated(state))),
+    }
+
+
+def _write_msgpack(path: str, payload: dict) -> None:
+    """Serialize + atomic tmp->rename write.  Pure host/file work — safe
+    to run on a background thread (AsyncSaver); a crash at any point
+    leaves the previous file at ``path`` intact."""
+    blob = serialization.msgpack_serialize(payload)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+    logging.info(f"epoch:{payload['epoch']:04d}: model saved to {path}")
+
+
 def save_checkpoint(path: str, model_name: str, state: TrainState,
                     epoch: int, best_valid_loss: float,
                     fmt: str = "msgpack") -> None:
@@ -118,21 +147,159 @@ def save_checkpoint(path: str, model_name: str, state: TrainState,
         if fmt == "orbax":
             return _save_orbax(path, model_name, state, epoch,
                                best_valid_loss)
-        payload = {
-            "format_version": _FORMAT_VERSION,
-            "model_name": model_name,
-            "epoch": int(epoch),
-            "loss": float(best_valid_loss),
-            "state": serialization.to_state_dict(
-                jax.device_get(gather_replicated(state))),
-        }
-        blob = serialization.msgpack_serialize(payload)
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-        os.replace(tmp, path)
-        logging.info(f"epoch:{epoch:04d}: model saved to {path}")
+        _write_msgpack(path, _msgpack_payload(model_name, state, epoch,
+                                              best_valid_loss))
+
+
+_SAVER_SHUTDOWN = object()
+
+
+class AsyncSaver:
+    """Ordered background checkpoint I/O (--ckpt-async).
+
+    One daemon worker thread drains a FIFO job queue, so every submitted
+    job (rolling write, best-model write, rotation delete) runs in
+    exactly the order the driver issued it — a newer save can never race
+    an older one onto the same path, and a rotation can never delete a
+    file whose (earlier-submitted) write is still pending.  ``submit``
+    returns immediately; the driver's critical path holds only the
+    snapshot work done before submitting.
+
+    A background exception is captured and re-raised from the NEXT
+    ``submit``/``wait``/``close`` on the driver thread, so a failing
+    write cannot pass silently.  Drivers must ``wait()`` (or ``close()``)
+    before process exit — and before telemetry close, so the background
+    spans land in the JSONL.
+    """
+
+    def __init__(self):
+        self._queue = queue_mod.Queue()
+        self._exc: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def _worker(self) -> None:
+        while True:
+            fn = self._queue.get()
+            try:
+                if fn is _SAVER_SHUTDOWN:
+                    return
+                fn()
+            except BaseException as e:
+                self._exc = e
+            finally:
+                self._queue.task_done()
+
+    def _raise_pending(self) -> None:
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+
+    @property
+    def in_flight(self) -> bool:
+        return self._thread is not None \
+            and self._queue.unfinished_tasks > 0
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        self._raise_pending()
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._worker,
+                                            name="dpt-ckpt-writer",
+                                            daemon=True)
+            self._thread.start()
+        self._queue.put(fn)
+
+    def wait(self) -> None:
+        """Block until every submitted job finished; re-raise failures."""
+        if self._thread is not None:
+            self._queue.join()
+        self._raise_pending()
+
+    def close(self) -> None:
+        """wait() + retire the worker thread (no leak across runs)."""
+        if self._thread is not None:
+            self._queue.put(_SAVER_SHUTDOWN)
+            self._queue.join()
+            self._thread.join()
+            self._thread = None
+        self._raise_pending()
+
+
+_warned_async_multihost = False
+
+
+def save_checkpoint_async(saver: AsyncSaver, path: str, model_name: str,
+                          state: TrainState, epoch: int,
+                          best_valid_loss: float,
+                          fmt: str = "msgpack") -> None:
+    """--ckpt-async: only the snapshot blocks the driver; serialization
+    and file I/O happen on ``saver``'s background thread, joined at the
+    next save / preemption / exit.
+
+    msgpack: the blocking part is the (possibly collective — same caller
+    contract as ``save_checkpoint``) gather + device_get snapshot; the
+    background part is msgpack serialize + tmp write + atomic rename.
+
+    orbax: the blocking part is orbax's own synchronous D2H copy inside
+    ``AsyncCheckpointer.save`` (donation-safe: the arrays are on host
+    before it returns) — plus a join of any still-pending job, because
+    consecutive saves to the SAME path share a ``.tmp`` directory and
+    must not overlap; the background part waits for the shard writes
+    and then runs the meta + atomic swap finalize.  Multi-host orbax
+    falls back to the synchronous path: the finalize barriers are
+    COLLECTIVE and must not run on a background thread concurrently
+    with training collectives.
+
+    Both formats produce byte-identical files to their sync paths and
+    keep the tmp->rename crash-safety protocol: a kill mid-background-
+    write leaves the previous checkpoint at ``path`` loadable.
+    """
+    tel = telemetry.get()
+    if fmt == "orbax" and jax.process_count() > 1:
+        global _warned_async_multihost
+        if not _warned_async_multihost:
+            logging.warning(
+                "--ckpt-async with --ckpt-format orbax on a multi-host "
+                "mesh falls back to synchronous saves (the finalize "
+                "barrier is collective and cannot run on a background "
+                "thread)")
+            _warned_async_multihost = True
+        saver.wait()  # ordering with any earlier async save
+        return save_checkpoint(path, model_name, state, epoch,
+                               best_valid_loss, fmt=fmt)
+
+    attrs = dict(fmt=fmt, epoch=int(epoch), file=os.path.basename(path))
+    if fmt == "orbax":
+        with tel.span("ckpt_save_blocking", **attrs):
+            saver.wait()
+            import orbax.checkpoint as ocp
+
+            abs_path = os.path.abspath(path)
+            tmp = abs_path + ".tmp"
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            ckptr = ocp.StandardCheckpointer()
+            state_sd = serialization.to_state_dict(state)
+            ckptr.save(os.path.join(tmp, "state"), state_sd)
+            meta = _orbax_meta(model_name, epoch, best_valid_loss,
+                               state_sd)
+
+        def finalize():
+            with telemetry.get().span("ckpt_save_background", **attrs):
+                ckptr.wait_until_finished()
+                _orbax_finalize(abs_path, tmp, meta)
+
+        saver.submit(finalize)
+        return
+
+    with tel.span("ckpt_save_blocking", **attrs):
+        payload = _msgpack_payload(model_name, state, epoch,
+                                   best_valid_loss)
+
+    def write():
+        with telemetry.get().span("ckpt_save_background", **attrs):
+            _write_msgpack(path, payload)
+
+    saver.submit(write)
 
 
 def require_orbax() -> None:
@@ -184,24 +351,38 @@ def _save_orbax(path: str, model_name: str, state: TrainState,
     ckptr.wait_until_finished()
     runtime.barrier()  # every host's shards are on disk before the swap
     if jax.process_index() == 0:
-        with open(os.path.join(tmp, _ORBAX_META), "w") as f:
-            # params_layout ('stacked' | 'blocks' | null) lets the loader
-            # restore a pipeline-trained directory into a plain model
-            # (and vice versa) without guessing the on-disk tree shape.
-            json.dump({"format_version": _FORMAT_VERSION,
-                       "model_name": model_name, "epoch": int(epoch),
-                       "loss": float(best_valid_loss),
-                       "params_layout": vit_pipeline.params_layout(
-                           state_sd.get("params")),
-                       # lets the loader refuse a cross-layout restore
-                       # into/out of a MoE tree with a clear message
-                       # instead of an opaque structure mismatch
-                       "moe": _has_moe_blocks(state_sd.get("params"))}, f)
-        if os.path.exists(path):
-            shutil.rmtree(path)
-        os.replace(tmp, path)
-        logging.info(f"epoch:{epoch:04d}: model saved to {path}")
+        _orbax_finalize(path, tmp,
+                        _orbax_meta(model_name, epoch, best_valid_loss,
+                                    state_sd))
     runtime.barrier()  # no host proceeds until the swap is visible
+
+
+def _orbax_meta(model_name: str, epoch: int, best_valid_loss: float,
+                state_sd: dict) -> dict:
+    # params_layout ('stacked' | 'blocks' | null) lets the loader
+    # restore a pipeline-trained directory into a plain model
+    # (and vice versa) without guessing the on-disk tree shape.
+    return {"format_version": _FORMAT_VERSION,
+            "model_name": model_name, "epoch": int(epoch),
+            "loss": float(best_valid_loss),
+            "params_layout": vit_pipeline.params_layout(
+                state_sd.get("params")),
+            # lets the loader refuse a cross-layout restore
+            # into/out of a MoE tree with a clear message
+            # instead of an opaque structure mismatch
+            "moe": _has_moe_blocks(state_sd.get("params"))}
+
+
+def _orbax_finalize(path: str, tmp: str, meta: dict) -> None:
+    """meta.json write + the atomic tmp->dir swap (single writer).  The
+    COMPLETE checkpoint exists under .tmp before this runs, so a crash
+    before/inside it leaves the previous checkpoint at ``path`` intact."""
+    with open(os.path.join(tmp, _ORBAX_META), "w") as f:
+        json.dump(meta, f)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.replace(tmp, path)
+    logging.info(f"epoch:{meta['epoch']:04d}: model saved to {path}")
 
 
 def _has_moe_blocks(params) -> bool:

@@ -17,6 +17,7 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import checkpoint as ckpt
 from . import parallel, runtime, telemetry, utils
@@ -108,15 +109,38 @@ def _saveable_state(cfg: Config, state):
 
 
 def _save_ckpt(cfg: Config, path: str, model_name: str, saveable,
-               epoch: int, best_valid_loss: float) -> None:
+               epoch: int, best_valid_loss: float, saver=None) -> None:
     """msgpack: main-only file write; orbax: EVERY process calls (each
-    host writes its own shards)."""
+    host writes its own shards).  With --ckpt-async (``saver`` set) only
+    the snapshot blocks; the write is queued on the background writer."""
     if cfg.ckpt_format == "orbax":
-        ckpt.save_checkpoint(path, model_name, saveable, epoch,
-                             best_valid_loss, fmt="orbax")
+        if saver is not None:
+            ckpt.save_checkpoint_async(saver, path, model_name, saveable,
+                                       epoch, best_valid_loss, fmt="orbax")
+        else:
+            ckpt.save_checkpoint(path, model_name, saveable, epoch,
+                                 best_valid_loss, fmt="orbax")
     elif runtime.is_main():
-        ckpt.save_checkpoint(path, model_name, saveable, epoch,
-                             best_valid_loss)
+        if saver is not None:
+            ckpt.save_checkpoint_async(saver, path, model_name, saveable,
+                                       epoch, best_valid_loss)
+        else:
+            ckpt.save_checkpoint(path, model_name, saveable, epoch,
+                                 best_valid_loss)
+
+
+def _rotate_ckpt(cfg: Config, saver, model_name: str, epoch: int) -> None:
+    """Rolling-file rotation, ordered with the async writer: a pending
+    background write of epoch-1's file must land BEFORE the delete, or
+    the write would resurrect the file after rotation and leak it."""
+    if not runtime.is_main():
+        return
+    if saver is not None:
+        saver.submit(lambda: ckpt.rotate_checkpoint(
+            cfg.rsl_path, cfg.dataset, model_name, epoch))
+    else:
+        ckpt.rotate_checkpoint(cfg.rsl_path, cfg.dataset, model_name,
+                               epoch)
 
 
 def _make_loader(cfg: Config, split: Split, mesh, shuffle: bool):
@@ -128,7 +152,8 @@ def _make_loader(cfg: Config, split: Split, mesh, shuffle: bool):
                     and split.images.nbytes <= _resident_budget_bytes(cfg)))
     cls = ResidentLoader if resident else ShardedLoader
     return cls(split, mesh, cfg.batch_size, shuffle=shuffle, seed=cfg.seed,
-               prefetch=cfg.prefetch)
+               prefetch=cfg.prefetch,
+               producer_threads=cfg.producer_threads)
 
 
 def _mfu_factors(engine: Engine) -> tuple:
@@ -157,6 +182,86 @@ def _record_throughput(tel, sps_chip: float, fps, peak, epoch: int) -> None:
         tel.gauge("throughput/mfu").set(
             None, epoch=epoch,
             reason="unknown_peak" if fps else "unknown_model_flops")
+
+
+def _sds(shape, dtype, sharding) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(tuple(shape), dtype, sharding=sharding)
+
+
+def _aot_warmup(cfg: Config, engine: Engine, state, train_loader,
+                valid_loader, root, start_epoch: int) -> None:
+    """--aot-warmup: lower+compile the epoch-0 train/eval programs against
+    abstract batch shapes BEFORE the first epoch, so step-1 latency is
+    bounded by a measured, recorded compile instead of surprising the
+    first dispatch.  With the persistent compilation cache enabled, a
+    second run of the same config turns this into a disk hit — recorded
+    as ``compile/cache_hit = 1`` with a much smaller ``compile/warmup_s``.
+
+    The compiled executables are NOT kept: the warmup's value is filling
+    the persistent cache (and XLA's backend caches) so the training
+    loop's own jit dispatch compiles from cache, not from scratch.
+    """
+    tel = telemetry.get()
+    hits_before = runtime.compilation_cache_hits()
+    t0 = time.perf_counter()
+    key = utils.fold_key(root, start_epoch)
+
+    def plan(loader, stacked=0):
+        steps = (loader.batches_per_epoch, loader.global_batch)
+        shape = ((stacked,) + steps) if stacked else steps
+        sharding = NamedSharding(
+            loader.mesh,
+            P(None, None, runtime.DATA_AXIS) if stacked
+            else P(None, runtime.DATA_AXIS))
+        return (_sds(shape, np.int32, sharding),
+                _sds(shape, bool, sharding))
+
+    def batch(loader):
+        gb = loader.global_batch
+        sh = loader.sharding
+        imgs = loader.split.images
+        return (_sds((gb,) + imgs.shape[1:], imgs.dtype, sh),
+                _sds((gb,), loader.split.labels.dtype, sh),
+                _sds((gb,), bool, sh))
+
+    k = (min(cfg.epochs_per_dispatch, cfg.nb_epochs - start_epoch)
+         if cfg.epochs_per_dispatch > 1 else 0)
+    if (k > 1 and isinstance(train_loader, ResidentLoader)
+            and isinstance(valid_loader, ResidentLoader)):
+        # Chunked path: ONE fused program covers train+eval for K epochs.
+        idx_tr, valid_tr = plan(train_loader, stacked=k)
+        idx_va, valid_va = plan(valid_loader, stacked=k)
+        keys = jnp.stack([utils.fold_key(root, start_epoch + i)
+                          for i in range(k)])
+        engine.train_epochs.lower(
+            state, train_loader.images, train_loader.labels, idx_tr,
+            valid_tr, valid_loader.images, valid_loader.labels,
+            idx_va, valid_va, keys).compile()
+    else:
+        if isinstance(train_loader, ResidentLoader):
+            idx_tr, valid_tr = plan(train_loader)
+            engine.train_epoch.lower(
+                state, train_loader.images, train_loader.labels, idx_tr,
+                valid_tr, key).compile()
+        else:
+            img, lbl, vld = batch(train_loader)
+            engine.train_step.lower(state, img, lbl, vld, key).compile()
+        if isinstance(valid_loader, ResidentLoader):
+            idx_va, valid_va = plan(valid_loader)
+            engine.eval_epoch.lower(
+                state, valid_loader.images, valid_loader.labels, idx_va,
+                valid_va).compile()
+        else:
+            img, lbl, vld = batch(valid_loader)
+            engine.eval_step.lower(state, img, lbl, vld).compile()
+    warmup_s = time.perf_counter() - t0
+    hit = runtime.compilation_cache_hits() > hits_before
+    tel.gauge("compile/warmup_s").set(warmup_s)
+    tel.gauge("compile/cache_hit").set(1.0 if hit else 0.0)
+    if runtime.is_main():
+        logging.info(f"AOT warmup: train/eval programs compiled in "
+                     f"{warmup_s:.2f}s "
+                     f"({'persistent-cache hit' if hit else 'cold'})")
 
 
 def _run_eval_pass(engine: Engine, state, loader, epoch: int
@@ -263,7 +368,7 @@ def _run_train_pass(engine: Engine, state, loader, epoch: int, key
 def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
                        valid_loader, model_name: str, root, start_epoch: int,
                        best_valid_loss: float, start_time: float,
-                       world: int, shutdown) -> dict:
+                       world: int, shutdown, saver=None) -> dict:
     """--epochs-per-dispatch > 1: K (train+valid) epochs per XLA dispatch.
 
     Per-epoch metrics and log lines are identical to the per-epoch path
@@ -339,16 +444,13 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
 
         last = chunk[-1]
         saveable = _saveable_state(cfg, state)
-        if runtime.is_main():
-            ckpt.rotate_checkpoint(cfg.rsl_path, cfg.dataset, model_name,
-                                   last)
-            for prev in chunk[:-1]:  # rolling files from earlier chunks
-                ckpt.rotate_checkpoint(cfg.rsl_path, cfg.dataset, model_name,
-                                       prev)
+        _rotate_ckpt(cfg, saver, model_name, last)
+        for prev in chunk[:-1]:  # rolling files from earlier chunks
+            _rotate_ckpt(cfg, saver, model_name, prev)
         _save_ckpt(cfg,
                    ckpt.checkpoint_path(cfg.rsl_path, cfg.dataset,
                                         model_name, last),
-                   model_name, saveable, last, best_valid_loss)
+                   model_name, saveable, last, best_valid_loss, saver)
         if chunk_improved:
             # Only the chunk-final state exists on host, so the best
             # file holds it (an approximation of the true best epoch
@@ -358,7 +460,7 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
             _save_ckpt(cfg,
                        ckpt.best_model_path(cfg.rsl_path, cfg.dataset,
                                             model_name),
-                       model_name, saveable, last, best_valid_loss)
+                       model_name, saveable, last, best_valid_loss, saver)
         epoch = last + 1
         tel.flush()  # chunk boundary: buffered events hit the disk
         # Agreed across hosts so everyone leaves at the same chunk
@@ -385,6 +487,9 @@ def run_train(cfg: Config) -> dict:
     # After distributed init so the rank in the filename is the GLOBAL
     # process index (per-rank files are the multi-host contract).
     tel = telemetry.configure(cfg.rsl_path, cfg.telemetry)
+    # Before the first jit compile, so every program of this run can be
+    # served from / written to the persistent cache.
+    runtime.configure_compilation_cache(cfg.compilation_cache_path())
     mesh = runtime.make_mesh(model_parallel=cfg.model_parallel,
                              seq_parallel=cfg.seq_parallel)
     world = runtime.world_size()
@@ -563,6 +668,11 @@ def run_train(cfg: Config) -> dict:
         state = _place_state(state, mesh, cfg)
         start_epoch, best_valid_loss = 0, float("inf")
 
+    if cfg.aot_warmup:
+        _aot_warmup(cfg, engine, state, train_loader, valid_loader, root,
+                    start_epoch)
+
+    saver = ckpt.AsyncSaver() if cfg.ckpt_async else None
     start_time = utils.monotonic()
     shutdown = utils.GracefulShutdown()
     try:
@@ -571,22 +681,30 @@ def run_train(cfg: Config) -> dict:
                 return _run_train_chunked(cfg, engine, state, train_loader,
                                           valid_loader, model_name, root,
                                           start_epoch, best_valid_loss,
-                                          start_time, world, shutdown)
+                                          start_time, world, shutdown,
+                                          saver)
             return _run_train_epochs(cfg, engine, state, train_loader,
                                      valid_loader, model_name, root,
                                      start_epoch, best_valid_loss,
-                                     start_time, world, shutdown)
+                                     start_time, world, shutdown, saver)
     finally:
-        # Counter/histogram summaries are emitted here — also on an
-        # exception/preemption path, so a killed run still leaves a
-        # readable telemetry trail.
-        tel.close()
+        # Join pending background checkpoint writes FIRST (their spans
+        # must land before the close below; a preempted/finished run must
+        # not exit with a half-written rolling file), then emit the
+        # counter/histogram summaries — also on an exception path, so a
+        # killed run still leaves a readable telemetry trail.
+        try:
+            if saver is not None:
+                saver.close()
+        finally:
+            tel.close()
+            runtime.reset_compilation_cache()
 
 
 def _run_train_epochs(cfg: Config, engine: Engine, state, train_loader,
                       valid_loader, model_name: str, root, start_epoch: int,
                       best_valid_loss: float, start_time: float, world: int,
-                      shutdown) -> dict:
+                      shutdown, saver=None) -> dict:
     """The per-epoch driver loop (ref classif.py:151-192)."""
     history = []
     tel = telemetry.get()
@@ -645,17 +763,16 @@ def _run_train_epochs(cfg: Config, engine: Engine, state, train_loader,
             # North-star metric surfaced per epoch (BASELINE.md).
             logging.info(f"  Throughput  | {sps_chip:,.0f} samples/s/chip "
                          f"({world} chip{'s' if world > 1 else ''})")
-            ckpt.rotate_checkpoint(cfg.rsl_path, cfg.dataset, model_name,
-                                   epoch)
+        _rotate_ckpt(cfg, saver, model_name, epoch)
         _save_ckpt(cfg,
                    ckpt.checkpoint_path(cfg.rsl_path, cfg.dataset,
                                         model_name, epoch),
-                   model_name, saveable, epoch, best_valid_loss)
+                   model_name, saveable, epoch, best_valid_loss, saver)
         if improved:
             _save_ckpt(cfg,
                        ckpt.best_model_path(cfg.rsl_path, cfg.dataset,
                                             model_name),
-                       model_name, saveable, epoch, best_valid_loss)
+                       model_name, saveable, epoch, best_valid_loss, saver)
         history.append({"epoch": epoch, "train_loss": train_loss,
                         "train_acc": train_acc, "valid_loss": valid_loss,
                         "valid_acc": valid_acc})
@@ -702,6 +819,7 @@ def run_test(cfg: Config) -> dict:
     utils.initialize_logging(cfg.rsl_path, cfg.log_file,
                              truncate=runtime.is_main())
     tel = telemetry.configure(cfg.rsl_path, cfg.telemetry)
+    runtime.configure_compilation_cache(cfg.compilation_cache_path())
     mesh = runtime.make_mesh(model_parallel=cfg.model_parallel,
                              seq_parallel=cfg.seq_parallel)
     tel.event("run_start", action="test", dataset=cfg.dataset,
@@ -736,6 +854,7 @@ def run_test(cfg: Config) -> dict:
         loss, acc = _run_eval_pass(engine, state, test_loader, epoch=0)
     finally:
         tel.close()
+        runtime.reset_compilation_cache()
     mins, secs = utils.get_duration(start_time, utils.monotonic())
     if runtime.is_main():  # ref classif.py:242-243
         logging.info(f"Time: {mins}m {secs}s, Acc: {acc * 100:.2f}%")

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 from typing import Optional
 
 # Reference defaults (ref: config.py)
@@ -83,6 +84,28 @@ class Config:
     checkpoint_file: Optional[str] = None  # -f: resume (train) / model (test)
     debug: bool = DEBUG                    # 200-sample subset, ref dataloader.py:139-144
     prefetch: int = NUM_WORKERS            # device prefetch depth
+    # Background host-pipeline threads for the streaming loader: the
+    # per-step numpy gather + device_put dispatch move off the driver
+    # thread onto N producers feeding bounded queues (byte-identical
+    # batch order to the synchronous path).  0 = synchronous production
+    # on the consumer thread (the pre-overlap behavior, and what direct
+    # ShardedLoader constructions default to).
+    producer_threads: int = 1
+    # Non-blocking checkpoint saves: only the host snapshot blocks the
+    # driver; serialization/file-I/O run on a background writer joined at
+    # the next save, preemption, or exit (checkpoint.AsyncSaver).  The
+    # .tmp->rename crash-safety protocol and the on-disk bytes are
+    # identical to the synchronous path.
+    ckpt_async: bool = False
+    # Persistent XLA compilation cache (runtime.configure_compilation_
+    # cache): None -> RSL_PATH/xla_cache unless no_compile_cache.  A
+    # second run of the same config skips every XLA compile (disk hit).
+    compilation_cache_dir: Optional[str] = None
+    no_compile_cache: bool = False
+    # Lower+compile the train/eval programs against abstract batch shapes
+    # BEFORE epoch 0 (AOT), so step-1 latency is bounded and recorded
+    # (compile/warmup_s + compile/cache_hit telemetry gauges).
+    aot_warmup: bool = False
     half_precision: bool = True            # bfloat16 compute on TPU (MXU-native)
     focal_gamma: float = 2.0               # ref utils.py:144
     # 'resident': split lives in HBM, one XLA dispatch per epoch;
@@ -152,6 +175,15 @@ class Config:
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
 
+    def compilation_cache_path(self) -> Optional[str]:
+        """The effective persistent-cache dir: the explicit override, the
+        RSL_PATH/xla_cache default, or None under --no-compile-cache."""
+        if self.no_compile_cache:
+            return None
+        if self.compilation_cache_dir:
+            return self.compilation_cache_dir
+        return os.path.join(self.rsl_path, "xla_cache")
+
 
 def _common_args(p: argparse.ArgumentParser) -> None:
     """Flags shared by train and test (ref: main.py:23-33)."""
@@ -184,6 +216,29 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                    help="streamed-mode device prefetch depth (the ref "
                         f"NUM_WORKERS analogue; default {NUM_WORKERS}; "
                         "0 = strictly synchronous)")
+    p.add_argument("--producer-threads", type=int, default=1, metavar="N",
+                   dest="producerThreads",
+                   help="streamed-mode background host-pipeline threads "
+                        "(gather + device_put off the driver thread; "
+                        "batch order stays byte-identical; default 1; "
+                        "0 = produce synchronously on the driver)")
+    p.add_argument("--ckpt-async", action="store_true", dest="ckptAsync",
+                   help="non-blocking checkpoint saves: serialization + "
+                        "file I/O run on a background writer joined at "
+                        "the next save/preemption/exit (same bytes, same "
+                        "crash-safety as sync)")
+    p.add_argument("--compilation-cache-dir", type=str, default=None,
+                   dest="compilationCacheDir", metavar="DIR",
+                   help="persistent XLA compilation cache directory "
+                        "(default RSL_PATH/xla_cache)")
+    p.add_argument("--no-compile-cache", action="store_true",
+                   dest="noCompileCache",
+                   help="disable the persistent XLA compilation cache")
+    p.add_argument("--aot-warmup", action="store_true", dest="aotWarmup",
+                   help="AOT-compile the train/eval programs against "
+                        "abstract batch shapes before epoch 0 (records "
+                        "compile/warmup_s + compile/cache_hit telemetry "
+                        "gauges)")
     p.add_argument("--feature-extract", action="store_true",
                    dest="featureExtract", default=FEATURE_EXTRACT,
                    help="freeze the backbone, train only the classifier "
@@ -326,6 +381,11 @@ def config_from_argv(argv=None) -> Config:
         half_precision=not args.no_bf16,
         data_mode=args.dataMode,
         prefetch=args.prefetch,
+        producer_threads=args.producerThreads,
+        ckpt_async=args.ckptAsync,
+        compilation_cache_dir=args.compilationCacheDir,
+        no_compile_cache=args.noCompileCache,
+        aot_warmup=args.aotWarmup,
         synthetic_fallback=args.syntheticFallback,
         profile=args.profile,
         telemetry=args.telemetry,

@@ -22,6 +22,8 @@ wraparound padding the sampler added to keep shapes static (see sampler.py).
 from __future__ import annotations
 
 import collections
+import queue as queue_mod
+import threading
 import time
 from typing import Iterator, Tuple
 
@@ -50,8 +52,9 @@ class ResidentLoader:
     """
 
     def __init__(self, split: Split, mesh: Mesh, batch_per_replica: int,
-                 shuffle: bool, seed: int, prefetch: int = 0):
-        del prefetch  # no host loop to prefetch for
+                 shuffle: bool, seed: int, prefetch: int = 0,
+                 producer_threads: int = 0):
+        del prefetch, producer_threads  # no host loop to prefetch for
         self.mesh = mesh
         self.batch_per_replica = batch_per_replica
         self.world = mesh.devices.size
@@ -104,11 +107,33 @@ def _put_global(array: np.ndarray, sharding: NamedSharding) -> jax.Array:
     return jax.make_array_from_process_local_data(sharding, array)
 
 
+class _ProducerFailure:
+    """Wraps an exception raised on a producer thread so the consumer can
+    re-raise it at the step where the batch was due."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
 class ShardedLoader:
-    """Iterates one split as sharded global batches of shape (world*B, ...)."""
+    """Iterates one split as sharded global batches of shape (world*B, ...).
+
+    ``producer_threads > 0`` moves ALL per-step host work — the numpy
+    fancy-index gather and the (async) ``device_put`` dispatch — off the
+    consumer thread onto background producers feeding bounded queues, so
+    host production overlaps device compute instead of running serially
+    between steps.  Thread t produces steps t, t+N, t+2N, ... and the
+    consumer round-robins the queues, so the batch stream is byte-identical
+    (values AND order) to the synchronous path.  0 = the synchronous
+    reference behavior (and what direct library constructions default to;
+    the CLI default is 1 — see Config.producer_threads).
+    """
 
     def __init__(self, split: Split, mesh: Mesh, batch_per_replica: int,
-                 shuffle: bool, seed: int, prefetch: int = 2):
+                 shuffle: bool, seed: int, prefetch: int = 2,
+                 producer_threads: int = 0):
         self.split = split
         self.mesh = mesh
         self.batch_per_replica = batch_per_replica
@@ -118,6 +143,7 @@ class ShardedLoader:
         # collective rendezvous (single physical core); real TPUs overlap
         # these fine, so 0 is only for that environment.
         self.prefetch = max(0, prefetch)
+        self.producer_threads = max(0, producer_threads)
         self.world = mesh.devices.size
         self.sharding = NamedSharding(mesh, P(DATA_AXIS))
 
@@ -138,7 +164,9 @@ class ShardedLoader:
         # first prefetching iteration, and thereafter it reflects ONLY
         # the most recent ``epoch()`` generator (two interleaved
         # iterations of the same loader clobber each other's view —
-        # don't do that; each epoch() call rebinds it).
+        # don't do that; each epoch() call rebinds it).  Synchronous
+        # path: a deque of device batches; threaded path: the list of
+        # bounded per-producer queues.
         self._queue = None
 
     def __len__(self) -> int:
@@ -148,13 +176,18 @@ class ShardedLoader:
     def global_batch(self) -> int:
         return self.world * self.batch_per_replica
 
+    def _host_batch(self, per_rank, step: int):
+        """One step's host gather (the only per-step host compute) — a
+        method so tests can inject slowness/failures into either the
+        synchronous or the threaded production path."""
+        idx = np.concatenate([ix[step] for ix, _ in per_rank])
+        valid = np.concatenate([v[step] for _, v in per_rank])
+        return self.split.images[idx], self.split.labels[idx], valid
+
     def _host_batches(self, epoch: int):
         per_rank = [s.epoch_indices(epoch) for s in self.samplers]
-        imgs, labels = self.split.images, self.split.labels
         for step in range(self.batches_per_epoch):
-            idx = np.concatenate([ix[step] for ix, _ in per_rank])
-            valid = np.concatenate([v[step] for _, v in per_rank])
-            yield imgs[idx], labels[idx], valid
+            yield self._host_batch(per_rank, step)
 
     def _to_device(self, arrays) -> Tuple[jax.Array, ...]:
         if jax.process_count() == 1:
@@ -167,18 +200,27 @@ class ShardedLoader:
                                                   jax.Array]]:
         """Async-prefetched iterator over one epoch's sharded batches.
 
-        With telemetry enabled (telemetry.py) the instrumented twin of
-        each loop runs instead, feeding four counters: ``data/wait_s``
-        (host time producing+enqueueing batches — the data-wait half of
-        the data-vs-compute split; device_put is async so this is pure
-        host work), ``data/batches``, ``data/starved_steps`` (consumer
-        found no lookahead in the queue: H2D could not overlap that
-        step), and ``data/queue_depth_sum`` (divide by batches for mean
-        depth).  The disabled path is the original loop, untouched — no
-        clock reads, no counter lookups per step.
+        ``producer_threads > 0`` dispatches to the threaded path
+        (``_threaded_epoch``): production fully overlaps consumption and
+        ``data/wait_s`` measures true consumer blocking.  Otherwise, with
+        telemetry enabled (telemetry.py) the instrumented twin of each
+        synchronous loop runs instead, feeding the counters:
+        ``data/wait_s`` (steady-state host time producing+enqueueing
+        batches between yields — the data-wait half of the
+        data-vs-compute split; device_put is async so this is pure host
+        work), ``data/warmup_s`` (the prefetch initial fill, which runs
+        before the consumer requested anything), ``data/batches``,
+        ``data/starved_steps`` (consumer found no lookahead in the
+        queue: H2D could not overlap that step), and
+        ``data/queue_depth_sum`` (divide by batches for mean depth).
+        The disabled path is the original loop, untouched — no clock
+        reads, no counter lookups per step.
         """
-        host_iter = self._host_batches(epoch)
         tel = telemetry.get()
+        if self.producer_threads > 0:
+            yield from self._threaded_epoch(epoch, tel)
+            return
+        host_iter = self._host_batches(epoch)
         if self.prefetch == 0:
             if not tel.enabled:
                 for arrays in host_iter:
@@ -224,7 +266,10 @@ class ShardedLoader:
                 queue.append(self._to_device(next(host_iter)))
         except StopIteration:
             exhausted = True
-        wait.add(time.perf_counter() - t0)
+        # The initial fill runs before the consumer has requested a single
+        # batch — it is producer work, not consumer blocking, so it goes
+        # to its own counter and wait_s means steady-state blocking only.
+        tel.counter("data/warmup_s").add(time.perf_counter() - t0)
         while queue:
             depth_sum.add(len(queue))
             if len(queue) == 1 and not exhausted:
@@ -240,3 +285,90 @@ class ShardedLoader:
             except StopIteration:
                 exhausted = True
             wait.add(time.perf_counter() - t0)
+
+    def _threaded_epoch(self, epoch: int, tel):
+        """Background-producer iterator: host gather + device_put dispatch
+        run on ``producer_threads`` threads feeding bounded queues.
+
+        Ordering: thread t owns steps t, t+N, ... and its own queue; the
+        consumer round-robins queues in step order, so the stream is
+        byte-identical to the synchronous path for any N.  Shutdown: the
+        generator's ``finally`` (normal exhaustion, ``close()``, or a
+        consumer exception) sets the stop event, drains the queues, and
+        joins every producer — no thread outlives its epoch.  A producer
+        exception travels through its queue and re-raises on the consumer
+        at the step whose batch it replaced.
+
+        Telemetry (enabled path only): ``data/wait_s`` is TRUE consumer
+        blocking — time spent in ``queue.get`` — not producer work;
+        ``data/starved_steps`` counts get() calls that found the next
+        queue empty; ``data/queue_depth_sum`` samples the total buffered
+        lookahead across queues once per batch.
+        """
+        nthreads = self.producer_threads
+        depth = max(1, self.prefetch)
+        per_rank = [s.epoch_indices(epoch) for s in self.samplers]
+        stop = threading.Event()
+        queues = [queue_mod.Queue(maxsize=depth) for _ in range(nthreads)]
+        # Tests/bench introspection parity with the sync path: expose the
+        # bounded queues as this epoch's lookahead structure.
+        self._queue = queues
+
+        def _put(q, item) -> None:
+            # Bounded put that aborts promptly once the consumer is gone.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return
+                except queue_mod.Full:
+                    continue
+
+        def produce(t: int, q) -> None:
+            try:
+                for step in range(t, self.batches_per_epoch, nthreads):
+                    if stop.is_set():
+                        return
+                    _put(q, self._to_device(self._host_batch(per_rank,
+                                                             step)))
+            except BaseException as e:  # propagate to the consumer
+                _put(q, _ProducerFailure(e))
+
+        threads = [
+            threading.Thread(target=produce, args=(t, queues[t]),
+                             name=f"dpt-producer-{epoch}-{t}", daemon=True)
+            for t in range(nthreads)
+        ]
+        for th in threads:
+            th.start()
+        enabled = tel.enabled
+        if enabled:
+            wait = tel.counter("data/wait_s")
+            batches = tel.counter("data/batches")
+            starved = tel.counter("data/starved_steps")
+            depth_sum = tel.counter("data/queue_depth_sum")
+        try:
+            for step in range(self.batches_per_epoch):
+                q = queues[step % nthreads]
+                if enabled:
+                    depth_sum.add(sum(x.qsize() for x in queues))
+                    if q.empty():
+                        starved.add(1)
+                    t0 = time.perf_counter()
+                    item = q.get()
+                    wait.add(time.perf_counter() - t0)
+                    batches.add(1)
+                else:
+                    item = q.get()
+                if isinstance(item, _ProducerFailure):
+                    raise item.exc
+                yield item
+        finally:
+            stop.set()
+            for q in queues:  # unblock producers stuck on a full queue
+                while True:
+                    try:
+                        q.get_nowait()
+                    except queue_mod.Empty:
+                        break
+            for th in threads:
+                th.join()

@@ -199,6 +199,78 @@ def any_process(flag: bool) -> bool:
     return bool(np.any(flags))
 
 
+_cache_hits = 0
+_cache_listener_installed = False
+
+
+def _on_monitoring_event(name: str, **kwargs) -> None:
+    global _cache_hits
+    if name == "/jax/compilation_cache/cache_hits":
+        _cache_hits += 1
+
+
+def compilation_cache_hits() -> int:
+    """Persistent-compilation-cache hits observed in this process (via
+    jax.monitoring).  Consumers snapshot before a compile and diff after
+    — e.g. the --aot-warmup compile/cache_hit telemetry gauge."""
+    return _cache_hits
+
+
+def configure_compilation_cache(cache_dir: Optional[str]) -> None:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    Wires ``jax_compilation_cache_dir`` plus the two thresholds that
+    would otherwise silently skip this framework's programs (the default
+    1 s compile-time floor excludes exactly the small per-step programs
+    compiled most often), and installs the cache-hit monitoring listener.
+    ``None`` disables the cache (--no-compile-cache).  Call
+    ``reset_compilation_cache`` when the run is over — the config is
+    process-global and the dir may be a temporary run directory.
+    """
+    global _cache_listener_installed
+    if cache_dir is None:
+        reset_compilation_cache()
+        return
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    for opt, val in (("jax_persistent_cache_min_compile_time_secs", 0),
+                     ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(opt, val)
+        except Exception:  # older jax without the knob
+            pass
+    # The cache object is initialized once per process from the config —
+    # reset so THIS dir takes effect even if an earlier run set another.
+    _reset_cache_state()
+    if not _cache_listener_installed:
+        try:
+            from jax import monitoring
+
+            monitoring.register_event_listener(_on_monitoring_event)
+            _cache_listener_installed = True
+        except Exception:
+            pass
+
+
+def reset_compilation_cache() -> None:
+    """Detach the persistent cache (end of run / tests): later compiles
+    must not keep writing into a possibly-deleted run directory."""
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:
+        pass
+    _reset_cache_state()
+
+
+def _reset_cache_state() -> None:
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:
+        pass
+
+
 def device_memory_limit() -> Optional[int]:
     """Per-device accelerator memory in bytes, or None when unknown.
 

@@ -31,10 +31,20 @@ epoch seconds — and ``rank``):
 
 Span names used by the framework (the report groups on these):
   epoch, train_pass, eval_pass, train_dispatch, train_step, eval_step,
-  chunk_dispatch, ckpt_save, ckpt_restore.
+  chunk_dispatch, ckpt_save, ckpt_restore, ckpt_save_blocking,
+  ckpt_save_background.
 Counter/gauge names:
-  data/wait_s, data/batches, data/starved_steps, data/queue_depth_sum,
-  throughput/samples_per_sec_per_chip, throughput/mfu.
+  data/wait_s (steady-state consumer blocking), data/warmup_s (prefetch
+  initial fill, before the first batch was requested), data/batches,
+  data/starved_steps, data/queue_depth_sum,
+  throughput/samples_per_sec_per_chip, throughput/mfu,
+  compile/warmup_s, compile/cache_hit (--aot-warmup + the persistent
+  compilation cache, runtime.py).
+
+Thread-safety: the emit path is locked, and the span stack is
+THREAD-LOCAL — background workers (the async checkpoint writer, the
+pipeline producer threads) can open spans without corrupting the driver
+thread's parent chain.
 """
 
 from __future__ import annotations
@@ -180,10 +190,19 @@ class Telemetry:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
-        self._span_stack: List[str] = []
+        self._local = threading.local()
         self._buffer: List[str] = []
         self._lock = threading.Lock()
         self._file = None
+
+    @property
+    def _span_stack(self) -> List[str]:
+        # Per-thread: a span opened by a background writer must not become
+        # the parent of (or pop) the driver thread's spans.
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
 
     # -- registry -----------------------------------------------------
 
@@ -428,6 +447,10 @@ def render_report(agg: Dict[str, Any]) -> str:
     if starved is not None and batches:
         lines.append(f"prefetch: {int(starved)}/{int(batches)} steps found "
                      f"the queue empty")
+    warm = agg["counters"].get("data/warmup_s")
+    if warm is not None:
+        lines.append(f"prefetch warmup (initial fill): {warm:.3f}s "
+                     f"(excluded from wait_s)")
 
     gauges = agg["gauges"]
     tput = gauges.get("throughput/samples_per_sec_per_chip")
@@ -440,11 +463,29 @@ def render_report(agg: Dict[str, Any]) -> str:
     if mfu:
         lines.append(f"MFU: {mfu['mean'] * 100:.1f}%")
 
+    warmup = gauges.get("compile/warmup_s")
+    if warmup:
+        hit = gauges.get("compile/cache_hit", {}).get("mean")
+        lines.append(f"compile warmup: {warmup['mean']:.3f}s"
+                     + (f" (persistent-cache hit: "
+                        f"{'yes' if hit else 'no'})"
+                        if hit is not None else ""))
+
     ckpt = {n: s for n, s in spans.items()
-            if n in ("ckpt_save", "ckpt_restore")}
+            if n in ("ckpt_save", "ckpt_restore", "ckpt_save_blocking",
+                     "ckpt_save_background")}
     for name, s in sorted(ckpt.items()):
         lines.append(f"{name}: {s['count']}x, total {s['total_s']:.3f}s, "
                      f"mean {s['mean_s']:.3f}s")
+    blocking = spans.get("ckpt_save_blocking")
+    background = spans.get("ckpt_save_background")
+    if blocking and background:
+        total = blocking["total_s"] + background["total_s"]
+        if total > 0:
+            lines.append(
+                f"async checkpointing: {blocking['total_s']:.3f}s of "
+                f"{total:.3f}s save time on the critical path "
+                f"({blocking['total_s'] / total * 100:.1f}%)")
 
     preempts = [e for e in agg["events"] if e.get("name") == "preempt"]
     if preempts:

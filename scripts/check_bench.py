@@ -1,0 +1,72 @@
+#!/usr/bin/env python3
+"""Bench provenance gate (VERDICT r5 weak #1).
+
+Validates every committed ``BENCH_*.json`` headline against the
+machine-readable ``fresh`` flag bench.py now emits:
+
+  * ``fresh: false`` (a replayed last-known measurement — e.g. the TPU
+    tunnel was down) must NEVER carry a ``vs_baseline`` value: a stale
+    number compared against a fresh torch baseline is not a measurement.
+  * a row carrying an ``error`` field must be flagged ``fresh: false``.
+
+Rows written before the flag existed (no ``fresh`` key) are reported but
+tolerated — the gate hardens from this PR forward without rewriting
+history.  Exit 0 = clean, 1 = violation.
+"""
+
+import glob
+import json
+import os
+import sys
+
+
+def check_row(path: str, row: dict) -> list:
+    problems = []
+    if "fresh" not in row:
+        print(f"  {os.path.basename(path)}: legacy row (no 'fresh' flag) "
+              f"— tolerated")
+        return problems
+    if row["fresh"] is False and row.get("vs_baseline") is not None:
+        problems.append(
+            f"{path}: replayed measurement (fresh=false) must not "
+            f"populate vs_baseline (got {row['vs_baseline']!r})")
+    if row.get("error") and row["fresh"] is not False:
+        problems.append(
+            f"{path}: row carries an error ({row['error'][:60]}...) but "
+            f"is not flagged fresh=false")
+    return problems
+
+
+def main() -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_*.json")))
+    problems = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except ValueError as e:
+            problems.append(f"{path}: unreadable JSON ({e})")
+            continue
+        if isinstance(data, dict) and "metric" in data:
+            problems += check_row(path, data)
+        elif isinstance(data, dict) and isinstance(data.get("tail"), str):
+            # driver round files wrap the headline in a log tail; the
+            # last JSON-looking line is the bench output
+            for line in reversed(data["tail"].strip().splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        problems += check_row(path, json.loads(line))
+                    except ValueError:
+                        pass
+                    break
+    for p in problems:
+        print(f"PROBLEM: {p}", file=sys.stderr)
+    if not problems:
+        print(f"bench provenance OK ({len(paths)} file(s) checked)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,12 @@ echo "== gate: canary tests =="
 python -m pytest tests/test_pipeline.py tests/test_distributed.py \
     tests/test_graft_entry.py tests/test_engine.py -q -x -m "not slow"
 
+echo "== gate: bench provenance (fresh flag) =="
+python scripts/check_bench.py
+
+echo "== gate: overlap regression (telemetry) =="
+env -u XLA_FLAGS -u JAX_PLATFORMS python scripts/overlap_gate.py
+
 echo "== gate: dryrun_multichip(8) =="
 env -u XLA_FLAGS -u JAX_PLATFORMS python -c \
   "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun OK')"

@@ -1,0 +1,82 @@
+#!/usr/bin/env python3
+"""Fast overlap-regression gate leg (scripts/gate.sh).
+
+Runs a 2-epoch synthetic streaming training with every overlap feature
+on (--telemetry --producer-threads --ckpt-async --aot-warmup + the
+persistent compilation cache) and fails when the overlap machinery has
+regressed:
+
+  * ``data/starved_steps`` above the threshold fraction of batches —
+    the background producer is no longer keeping the queue fed;
+  * the telemetry report is missing the new compile gauges
+    (compile/warmup_s, compile/cache_hit) or the split checkpoint spans
+    (ckpt_save_blocking / ckpt_save_background).
+
+CPU-only (the virtual test mesh) and ~1 min — runs in the gate's canary
+tier, before any snapshot.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+MAX_STARVED_FRACTION = 0.34
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    from __graft_entry__ import _force_cpu_devices
+
+    _force_cpu_devices(1)
+
+    from distributedpytorch_tpu import telemetry
+    from distributedpytorch_tpu.cli import run_train
+    from distributedpytorch_tpu.config import Config
+
+    rsl = tempfile.mkdtemp(prefix="overlap_gate_")
+    cfg = Config(action="train", data_path="/nodata", rsl_path=rsl,
+                 dataset="synthetic", model_name="mlp", batch_size=8,
+                 nb_epochs=2, debug=True, half_precision=False,
+                 telemetry=True, data_mode="stream", producer_threads=1,
+                 ckpt_async=True, aot_warmup=True)
+    run_train(cfg)
+
+    with open(os.path.join(rsl, "telemetry", "rank0.jsonl")) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    agg = telemetry.aggregate(events)
+
+    problems = []
+    batches = agg["counters"].get("data/batches", 0)
+    starved = agg["counters"].get("data/starved_steps", 0)
+    if not batches:
+        problems.append("no data/batches counted — streaming telemetry "
+                        "is broken")
+    elif starved / batches > MAX_STARVED_FRACTION:
+        problems.append(
+            f"producer starvation regressed: {int(starved)}/{int(batches)}"
+            f" steps found the queue empty "
+            f"(> {MAX_STARVED_FRACTION:.0%} threshold)")
+    for gauge in ("compile/warmup_s", "compile/cache_hit"):
+        if gauge not in agg["gauges"]:
+            problems.append(f"missing {gauge} gauge (--aot-warmup "
+                            f"telemetry broken)")
+    for span in ("ckpt_save_blocking", "ckpt_save_background"):
+        if span not in agg["spans"]:
+            problems.append(f"missing {span} span (--ckpt-async "
+                            f"telemetry broken)")
+
+    report = telemetry.report(rsl)
+    for p in problems:
+        print(f"PROBLEM: {p}", file=sys.stderr)
+    if problems:
+        print(report, file=sys.stderr)
+        return 1
+    print(f"overlap gate OK: {int(starved)}/{int(batches)} starved steps, "
+          f"compile + ckpt gauges present")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,5 +1,6 @@
 """Child process for checkpoint topology/format tests
-(tests/test_ckpt_topology.py).
+(tests/test_ckpt_topology.py) and the async-checkpoint crash test
+(tests/test_async_ckpt.py).
 
 One simulated host: provisions local virtual CPU devices, optionally joins
 a gloo rendezvous, runs ``run_train`` with the requested checkpoint format
@@ -10,12 +11,73 @@ Unlike _mp_child.py, the ``--rsl`` directory is SHARED between processes:
 orbax multi-host checkpointing writes every host's shards into the same
 checkpoint directory (checkpoint.py _save_orbax barriers), which is the
 behavior under test.
+
+``--async-crash`` mode (tests/test_async_ckpt.py): saves a v1 bestmodel
+synchronously, kicks off an ASYNC v2 save whose background write is
+slowed via monkeypatch, and ``os._exit``s the moment the background
+thread reports it is inside the write — a deterministic stand-in for
+"process killed mid-background-checkpoint-write".  The parent asserts the
+v1 file is still fully loadable (the tmp->rename protocol's guarantee).
 """
 
 import argparse
 import json
 import os
 import sys
+import time
+
+
+def _tiny_state():
+    """A minimal real TrainState (mlp) without running the driver."""
+    import jax
+
+    from distributedpytorch_tpu.models import get_model
+    from distributedpytorch_tpu.ops.losses import get_loss_fn
+    from distributedpytorch_tpu.train.engine import Engine, make_optimizer
+
+    model = get_model("mlp", 10, half_precision=False)
+    tx = make_optimizer("adam", 1e-3, 0.9, 0.1, 4, False)
+    engine = Engine(model, "mlp", get_loss_fn("cross_entropy"), tx,
+                    mean=0.45, std=0.2, input_size=28,
+                    half_precision=False)
+    return engine.init_state(jax.random.PRNGKey(0))
+
+
+def async_crash(rsl: str, fmt: str) -> None:
+    """Sync-save v1, async-save v2 with a stalled background write, die."""
+    from distributedpytorch_tpu import checkpoint as ckpt
+
+    state = _tiny_state()
+    best = ckpt.best_model_path(rsl, "synthetic", "mlp")
+    ckpt.save_checkpoint(best, "mlp", state, 1, 0.5, fmt=fmt)
+
+    marker = os.path.join(rsl, "bg_started")
+
+    def stall(orig):
+        def slow(*args, **kwargs):
+            with open(marker, "w") as f:
+                f.write("1")
+            time.sleep(30)  # far longer than the child will live
+            return orig(*args, **kwargs)
+        return slow
+
+    if fmt == "orbax":
+        ckpt._orbax_finalize = stall(ckpt._orbax_finalize)
+    else:
+        ckpt._write_msgpack = stall(ckpt._write_msgpack)
+
+    saver = ckpt.AsyncSaver()
+    ckpt.save_checkpoint_async(saver, best, "mlp", state, 2, 0.25,
+                               fmt=fmt)
+    deadline = time.monotonic() + 20
+    while not os.path.exists(marker):
+        if time.monotonic() > deadline:
+            print("background write never started", file=sys.stderr)
+            os._exit(3)
+        time.sleep(0.01)
+    print("dying mid-background-write", file=sys.stderr)
+    sys.stderr.flush()
+    os._exit(0)  # daemon writer thread dies with the process
 
 
 def main() -> None:
@@ -25,11 +87,12 @@ def main() -> None:
     ap.add_argument("--pid", type=int, default=0)
     ap.add_argument("--devices-per-proc", type=int, default=2)
     ap.add_argument("--rsl", required=True)
-    ap.add_argument("--out", required=True)
+    ap.add_argument("--out", default=None)
     ap.add_argument("--epochs", type=int, default=1)
     ap.add_argument("--ckpt-format", default="msgpack")
     ap.add_argument("--model-parallel", type=int, default=1)
     ap.add_argument("--resume-from", default=None)
+    ap.add_argument("--async-crash", action="store_true")
     a = ap.parse_args()
 
     os.environ["XLA_FLAGS"] = (
@@ -46,6 +109,10 @@ def main() -> None:
                                        num_processes=a.nproc,
                                        process_id=a.pid)
         assert jax.process_count() == a.nproc
+
+    if a.async_crash:
+        async_crash(a.rsl, a.ckpt_format)
+        return  # unreachable (async_crash _exits)
 
     import numpy as np
 

@@ -1,0 +1,259 @@
+"""Non-blocking checkpoints (--ckpt-async, checkpoint.AsyncSaver):
+ordering/error semantics of the background writer, byte/bit identity of
+async vs sync saves for both formats, the driver-level flow, and the
+crash-safety guarantee — a kill mid-background-write leaves the previous
+bestmodel loadable (subprocess harness in tests/_ckpt_child.py)."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from tests._subproc import child_env
+
+from distributedpytorch_tpu import checkpoint as ckpt
+from distributedpytorch_tpu import telemetry
+from distributedpytorch_tpu.models import get_model
+from distributedpytorch_tpu.ops.losses import get_loss_fn
+from distributedpytorch_tpu.train.engine import Engine, make_optimizer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def restore_global():
+    yield
+    telemetry._active = telemetry.Telemetry(enabled=False)
+
+
+def _engine():
+    model = get_model("mlp", 10, half_precision=False)
+    tx = make_optimizer("adam", 1e-3, 0.9, 0.1, 4, False)
+    return Engine(model, "mlp", get_loss_fn("cross_entropy"), tx,
+                  mean=0.45, std=0.2, input_size=28,
+                  half_precision=False)
+
+
+@pytest.fixture(scope="module")
+def trained_state():
+    """One real optimizer step so opt_state moments are non-trivial."""
+    engine = _engine()
+    state = engine.init_state(jax.random.PRNGKey(7))
+    rng = np.random.default_rng(0)
+    state, _ = engine.train_step(
+        state, rng.integers(0, 256, (8, 28, 28), np.uint8),
+        rng.integers(0, 10, (8,)).astype(np.int32), np.ones(8, bool),
+        jax.random.PRNGKey(1))
+    return engine, state
+
+
+# -- AsyncSaver semantics ----------------------------------------------
+
+
+def test_saver_runs_jobs_in_order_and_waits():
+    saver = ckpt.AsyncSaver()
+    order = []
+    gate = threading.Event()
+
+    def slow():
+        gate.wait(5)
+        order.append("a")
+
+    saver.submit(slow)
+    saver.submit(lambda: order.append("b"))
+    assert saver.in_flight
+    assert order == []  # both queued behind the gate — nothing blocked
+    gate.set()
+    saver.wait()
+    assert order == ["a", "b"]
+    assert not saver.in_flight
+    saver.close()
+
+
+def test_saver_background_error_reraises_on_driver_thread():
+    saver = ckpt.AsyncSaver()
+
+    def boom():
+        raise RuntimeError("disk gone")
+
+    saver.submit(boom)
+    with pytest.raises(RuntimeError, match="disk gone"):
+        saver.wait()
+    # the saver recovers: later jobs still run
+    done = []
+    saver.submit(lambda: done.append(1))
+    saver.close()
+    assert done == [1]
+
+
+def test_saver_close_retires_worker_thread():
+    before = set(threading.enumerate())
+    saver = ckpt.AsyncSaver()
+    saver.submit(lambda: None)
+    saver.close()
+    deadline = time.monotonic() + 5
+    while set(threading.enumerate()) - before and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert set(threading.enumerate()) == before
+
+
+# -- async == sync equivalence (both formats) ---------------------------
+
+
+def test_msgpack_async_file_is_byte_identical_to_sync(tmp_path,
+                                                      trained_state):
+    _, state = trained_state
+    sync_path = str(tmp_path / "sync.ckpt")
+    async_path = str(tmp_path / "async.ckpt")
+    ckpt.save_checkpoint(sync_path, "mlp", state, 3, 0.25)
+    saver = ckpt.AsyncSaver()
+    ckpt.save_checkpoint_async(saver, async_path, "mlp", state, 3, 0.25)
+    saver.close()
+    with open(sync_path, "rb") as f:
+        sync_bytes = f.read()
+    with open(async_path, "rb") as f:
+        async_bytes = f.read()
+    assert sync_bytes == async_bytes  # resume is trivially bit-identical
+
+
+def test_msgpack_async_resume_state_bit_identical(tmp_path, trained_state):
+    engine, state = trained_state
+    path = str(tmp_path / "async.ckpt")
+    saver = ckpt.AsyncSaver()
+    ckpt.save_checkpoint_async(saver, path, "mlp", state, 3, 0.25)
+    saver.close()
+    template = engine.init_state(jax.random.PRNGKey(2))
+    restored, next_epoch, best = ckpt.load_checkpoint(path, template)
+    assert next_epoch == 4 and best == 0.25
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(state)),
+                    jax.tree_util.tree_leaves(jax.device_get(restored))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_orbax_async_restore_bit_identical_to_sync(tmp_path,
+                                                   trained_state):
+    engine, state = trained_state
+    sync_path = str(tmp_path / "sync_ck")
+    async_path = str(tmp_path / "async_ck")
+    ckpt.save_checkpoint(sync_path, "mlp", state, 3, 0.25, fmt="orbax")
+    saver = ckpt.AsyncSaver()
+    ckpt.save_checkpoint_async(saver, async_path, "mlp", state, 3, 0.25,
+                               fmt="orbax")
+    saver.close()
+    assert os.path.isdir(async_path)
+    assert not os.path.exists(async_path + ".tmp")  # finalize swapped it
+
+    restored = {}
+    for name, path in (("sync", sync_path), ("async", async_path)):
+        template = engine.init_state(jax.random.PRNGKey(2))
+        restored[name], next_epoch, best = ckpt.load_checkpoint(path,
+                                                                template)
+        assert next_epoch == 4 and best == 0.25
+    leaves = zip(jax.tree_util.tree_leaves(jax.device_get(state)),
+                 jax.tree_util.tree_leaves(
+                     jax.device_get(restored["sync"])),
+                 jax.tree_util.tree_leaves(
+                     jax.device_get(restored["async"])))
+    for orig, s, a in leaves:
+        np.testing.assert_array_equal(np.asarray(orig), np.asarray(s))
+        np.testing.assert_array_equal(np.asarray(orig), np.asarray(a))
+
+
+# -- telemetry split ----------------------------------------------------
+
+
+def test_async_save_splits_blocking_from_background_span(tmp_path,
+                                                         trained_state,
+                                                         restore_global,
+                                                         monkeypatch):
+    """--ckpt-async removes the write from the critical path: with an
+    artificially slow background write, the blocking span stays tiny
+    while the background span carries the full write duration."""
+    _, state = trained_state
+    tel = telemetry.configure(str(tmp_path), enabled=True, rank=0)
+
+    orig = ckpt._write_msgpack
+
+    def slow_write(path, payload):
+        time.sleep(0.5)
+        orig(path, payload)
+
+    monkeypatch.setattr(ckpt, "_write_msgpack", slow_write)
+    saver = ckpt.AsyncSaver()
+    t0 = time.perf_counter()
+    ckpt.save_checkpoint_async(saver, str(tmp_path / "ck"), "mlp", state,
+                               0, 1.0)
+    submit_s = time.perf_counter() - t0
+    assert submit_s < 0.4  # the 0.5 s write did not block the driver
+    saver.close()
+    tel.close()
+
+    import json
+    events = [json.loads(line)
+              for line in open(tmp_path / "telemetry" / "rank0.jsonl")]
+    spans = {e["name"]: e for e in events if e["kind"] == "span"}
+    assert spans["ckpt_save_background"]["dur_s"] >= 0.5
+    assert spans["ckpt_save_blocking"]["dur_s"] \
+        < spans["ckpt_save_background"]["dur_s"] / 2
+    # the background span was emitted from the writer thread with no
+    # parent leakage from the driver's stack
+    assert spans["ckpt_save_background"]["parent"] is None
+
+
+# -- crash safety (subprocess harness) ----------------------------------
+
+
+@pytest.mark.parametrize("fmt", ["msgpack", "orbax"])
+def test_kill_mid_background_write_keeps_previous_bestmodel(tmp_path, fmt):
+    """A process dying while the background writer is mid-write must
+    leave the previously saved bestmodel fully loadable (tmp->rename:
+    the final path is only ever touched by a completed write)."""
+    rsl = str(tmp_path / "rsl")
+    os.makedirs(rsl)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tests", "_ckpt_child.py"),
+         "--rsl", rsl, "--ckpt-format", fmt, "--async-crash",
+         "--devices-per-proc", "1"],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        env=child_env())
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "dying mid-background-write" in r.stderr
+
+    best = ckpt.best_model_path(rsl, "synthetic", "mlp")
+    # v1 (epoch 1, loss 0.5) is intact; the half-written v2 never landed
+    assert ckpt.get_checkpoint_model_name(best) == "mlp"
+    engine = _engine()
+    template = engine.init_state(jax.random.PRNGKey(3))
+    _, next_epoch, best_loss = ckpt.load_checkpoint(best, template)
+    assert next_epoch == 2 and best_loss == 0.5
+
+
+# -- driver-level flow --------------------------------------------------
+
+
+def test_run_train_ckpt_async_resume_matches_sync(tmp_path,
+                                                  restore_global):
+    """Same config trained with sync vs async checkpointing produces
+    byte-identical rolling + best files, and the async run's checkpoint
+    resumes cleanly."""
+    from distributedpytorch_tpu.cli import run_train
+    from distributedpytorch_tpu.config import Config
+
+    files = {}
+    for mode, async_flag in (("sync", False), ("async", True)):
+        rsl = str(tmp_path / mode)
+        cfg = Config(action="train", data_path="/tmp/nodata", rsl_path=rsl,
+                     dataset="synthetic", model_name="mlp", batch_size=8,
+                     nb_epochs=1, debug=True, half_precision=False,
+                     ckpt_async=async_flag)
+        run_train(cfg)
+        path = ckpt.checkpoint_path(rsl, "synthetic", "mlp", 0)
+        assert os.path.exists(path)
+        with open(path, "rb") as f:
+            files[mode] = f.read()
+    assert files["sync"] == files["async"]

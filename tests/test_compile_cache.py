@@ -1,0 +1,106 @@
+"""Persistent compilation cache + AOT warmup (--compilation-cache-dir /
+--no-compile-cache / --aot-warmup): config resolution, the gauges the
+warmup records, and the acceptance criterion — a second run of the same
+config against the same cache dir records compile/cache_hit = 1 with a
+lower compile/warmup_s than the cold run."""
+
+import json
+import os
+
+import jax
+import pytest
+
+from distributedpytorch_tpu import telemetry
+from distributedpytorch_tpu.cli import run_train
+from distributedpytorch_tpu.config import Config, config_from_argv
+
+
+@pytest.fixture
+def restore_global():
+    yield
+    telemetry._active = telemetry.Telemetry(enabled=False)
+
+
+# -- config resolution --------------------------------------------------
+
+
+def test_cache_dir_resolution_defaults_to_rsl_path():
+    cfg = Config(rsl_path="/some/rsl")
+    assert cfg.compilation_cache_path() == "/some/rsl/xla_cache"
+    assert Config(rsl_path="/r", no_compile_cache=True) \
+        .compilation_cache_path() is None
+    assert Config(compilation_cache_dir="/explicit") \
+        .compilation_cache_path() == "/explicit"
+    # opt-out wins over an explicit dir
+    assert Config(compilation_cache_dir="/explicit",
+                  no_compile_cache=True).compilation_cache_path() is None
+
+
+def test_cli_flags_roundtrip():
+    cfg = config_from_argv(["train", "-d", "/x",
+                            "--compilation-cache-dir", "/cache",
+                            "--aot-warmup", "--ckpt-async",
+                            "--producer-threads", "3"])
+    assert cfg.compilation_cache_dir == "/cache"
+    assert cfg.aot_warmup and cfg.ckpt_async
+    assert cfg.producer_threads == 3
+    assert not cfg.no_compile_cache
+    cfg = config_from_argv(["train", "-d", "/x", "--no-compile-cache"])
+    assert cfg.no_compile_cache
+    assert cfg.compilation_cache_path() is None
+    # defaults: cache on (under rsl), one producer thread, sync ckpts
+    cfg = config_from_argv(["train", "-d", "/x"])
+    assert cfg.compilation_cache_path().endswith("xla_cache")
+    assert cfg.producer_threads == 1 and not cfg.ckpt_async
+
+
+# -- the acceptance criterion ------------------------------------------
+
+
+def _warmup_gauges(rsl):
+    events = [json.loads(line)
+              for line in open(os.path.join(rsl, "telemetry",
+                                            "rank0.jsonl"))]
+    out = {}
+    for e in events:
+        if e["kind"] == "gauge" and e["name"].startswith("compile/"):
+            out[e["name"]] = e["value"]
+    return out
+
+
+def test_second_run_hits_cache_with_lower_warmup(tmp_path,
+                                                 restore_global):
+    cache = str(tmp_path / "cache")
+    gauges = []
+    for i in (0, 1):
+        if i == 1:
+            # drop the in-memory jit caches so the second run's compiles
+            # must go through the persistent cache — the cross-process
+            # situation the cache exists for, pinned in-process
+            jax.clear_caches()
+        cfg = Config(action="train", data_path="/tmp/nodata",
+                     rsl_path=str(tmp_path / f"run{i}"),
+                     dataset="synthetic", model_name="mlp", batch_size=8,
+                     nb_epochs=1, debug=True, half_precision=False,
+                     telemetry=True, aot_warmup=True,
+                     compilation_cache_dir=cache)
+        run_train(cfg)
+        gauges.append(_warmup_gauges(cfg.rsl_path))
+    cold, warm = gauges
+    assert cold["compile/cache_hit"] == 0.0
+    assert warm["compile/cache_hit"] == 1.0
+    assert warm["compile/warmup_s"] < cold["compile/warmup_s"]
+    assert os.listdir(cache)  # the cold run populated the cache
+    # run_train detached the cache on exit: later compiles must not
+    # write into (a possibly deleted) run directory
+    assert jax.config.jax_compilation_cache_dir is None
+
+
+def test_no_compile_cache_leaves_no_cache_dir(tmp_path, restore_global):
+    rsl = str(tmp_path / "rsl")
+    cfg = Config(action="train", data_path="/tmp/nodata", rsl_path=rsl,
+                 dataset="synthetic", model_name="mlp", batch_size=8,
+                 nb_epochs=1, debug=True, half_precision=False,
+                 no_compile_cache=True)
+    run_train(cfg)
+    assert not os.path.exists(os.path.join(rsl, "xla_cache"))

@@ -1,0 +1,181 @@
+"""Threaded host pipeline (--producer-threads, pipeline.ShardedLoader):
+the background producers must be invisible except for speed — identical
+batch stream (values AND order) to the synchronous path, clean exception
+propagation, no thread leaks across epochs — and the telemetry split
+must show the overlap: consumer wait_s drops when production overlaps
+consumption, and the prefetch initial fill lands in data/warmup_s, not
+wait_s."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu import runtime, telemetry
+from distributedpytorch_tpu.data.datasets import Split
+from distributedpytorch_tpu.data.io import make_synthetic
+from distributedpytorch_tpu.data.pipeline import ShardedLoader
+
+
+@pytest.fixture
+def restore_global():
+    yield
+    telemetry._active = telemetry.Telemetry(enabled=False)
+
+
+def _split(num_train=128):
+    tr_x, tr_y, _, _ = make_synthetic(num_train=num_train, num_test=8,
+                                      image_size=28, channels=1, seed=0)
+    return Split(tr_x, tr_y)
+
+
+def _loader(producer_threads, prefetch=2, shuffle=True, num_train=128):
+    return ShardedLoader(_split(num_train), runtime.make_mesh(),
+                         batch_per_replica=2, shuffle=shuffle, seed=7,
+                         prefetch=prefetch,
+                         producer_threads=producer_threads)
+
+
+def _materialize(loader, epoch):
+    return [tuple(np.asarray(a) for a in batch)
+            for batch in loader.epoch(epoch)]
+
+
+@pytest.mark.parametrize("prefetch", [0, 2])
+@pytest.mark.parametrize("nthreads", [1, 3])
+def test_threaded_stream_identical_to_sync(prefetch, nthreads):
+    """Byte-identical values and order for any thread count, under both
+    prefetch depths, across epochs (distinct shuffles)."""
+    sync = _loader(0, prefetch=prefetch)
+    threaded = _loader(nthreads, prefetch=prefetch)
+    for epoch in (0, 1):
+        got = _materialize(threaded, epoch)
+        want = _materialize(sync, epoch)
+        assert len(got) == len(want) == len(sync)
+        for g, w in zip(got, want):
+            for ga, wa in zip(g, w):
+                np.testing.assert_array_equal(ga, wa)
+
+
+def test_producer_exception_propagates_to_consumer():
+    loader = _loader(2)
+    orig = loader._host_batch
+
+    def failing(per_rank, step):
+        if step == 5:
+            raise RuntimeError("corrupt shard")
+        return orig(per_rank, step)
+
+    loader._host_batch = failing
+    got = []
+    with pytest.raises(RuntimeError, match="corrupt shard"):
+        for batch in loader.epoch(0):
+            got.append(batch)
+    # every batch before the failure was delivered in order
+    assert len(got) == 5
+
+
+def test_no_thread_leaks_across_epochs():
+    loader = _loader(2)
+    before = set(threading.enumerate())
+    for epoch in range(3):
+        for _ in loader.epoch(epoch):
+            pass
+    # partially-consumed epoch: generator close() must also reap threads
+    it = loader.epoch(3)
+    next(it)
+    it.close()
+    deadline = time.monotonic() + 10
+    while set(threading.enumerate()) - before \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert set(threading.enumerate()) == before
+
+
+def test_threaded_wait_drops_vs_sync(restore_global, tmp_path):
+    """The acceptance criterion: with a slow host gather and a busy
+    consumer, the threaded producer overlaps production with consumption
+    and data/wait_s (true consumer blocking) drops measurably vs the
+    synchronous path, which pays the gather inline every step."""
+    delay = 0.004
+
+    def measure(nthreads):
+        loader = _loader(nthreads, prefetch=2, num_train=256)
+        orig = loader._host_batch
+
+        def slow(per_rank, step):
+            time.sleep(delay)  # artificially slow host gather
+            return orig(per_rank, step)
+
+        loader._host_batch = slow
+        tel = telemetry.configure(str(tmp_path / f"t{nthreads}"),
+                                  enabled=True, rank=0)
+        n = 0
+        for _ in loader.epoch(0):
+            time.sleep(delay)  # consumer busy: the compute to hide under
+            n += 1
+        wait = tel.counter("data/wait_s").value
+        batches = tel.counter("data/batches").value
+        tel.close()
+        assert batches == n == len(loader)
+        return wait
+
+    sync_wait = measure(0)
+    threaded_wait = measure(1)
+    # sync pays ~every gather inline; threaded hides it under the
+    # consumer's own work.  Require at least a 2x drop (the observed
+    # drop is far larger; 2x keeps the assert robust on loaded CI).
+    assert threaded_wait < sync_wait / 2, (threaded_wait, sync_wait)
+
+
+def test_prefetch_initial_fill_counts_as_warmup_not_wait(restore_global,
+                                                         tmp_path):
+    """Satellite fix: the sync prefetch>0 loop's initial fill happens
+    before the consumer asked for anything — it must land in
+    data/warmup_s, leaving data/wait_s as steady-state blocking only.
+    Only the fill's two gathers are slowed, so before the fix wait_s
+    would absorb ~2*delay and the discrimination is unambiguous."""
+    delay = 0.05
+    loader = _loader(0, prefetch=2, num_train=256)
+    orig = loader._host_batch
+
+    def slow_first_two(per_rank, step):
+        if step < 2:  # exactly the prefetch=2 initial fill
+            time.sleep(delay)
+        return orig(per_rank, step)
+
+    loader._host_batch = slow_first_two
+    tel = telemetry.configure(str(tmp_path), enabled=True, rank=0)
+    n = sum(1 for _ in loader.epoch(0))
+    warmup = tel.counter("data/warmup_s").value
+    wait = tel.counter("data/wait_s").value
+    tel.close()
+    assert n == len(loader)
+    # the fill paid both slow gathers ...
+    assert warmup >= 2 * delay * 0.9
+    # ... and none of that time leaked into the steady-state counter
+    assert wait < delay
+
+
+def test_queue_introspection_and_counters_threaded(restore_global,
+                                                   tmp_path):
+    loader = _loader(2, prefetch=2)
+    tel = telemetry.configure(str(tmp_path), enabled=True, rank=0)
+    n = sum(1 for _ in loader.epoch(0))
+    assert n == len(loader)
+    assert tel.counter("data/batches").value == n
+    assert tel.counter("data/queue_depth_sum").value >= 0
+    assert 0 <= tel.counter("data/starved_steps").value <= n
+    # the bounded queues are exposed for tests/bench introspection
+    assert isinstance(loader._queue, list) and len(loader._queue) == 2
+    tel.close()
+
+
+def test_threaded_disabled_telemetry_counts_nothing(restore_global):
+    loader = _loader(1)
+    tel = telemetry.get()
+    assert not tel.enabled
+    n = sum(1 for _ in loader.epoch(0))
+    assert n == len(loader)
+    assert tel.counter("data/batches").value == 0
