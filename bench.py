@@ -191,7 +191,7 @@ def bench_ours(batch_per_replica: int, steps: int, model_name: str,
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
         xla_flops = float(cost.get("flops", 0.0))
-    except Exception:
+    except Exception:  # cost_analysis is best-effort, backend-dependent
         pass
     out = {"model": model_name, "batch_per_replica": batch_per_replica,
            "image_size": image_size, "channels": channels,
@@ -806,7 +806,7 @@ def _fallback_headline() -> dict | None:
                          "(tunnel down); value is the last on-chip "
                          "measurement committed in BENCH_SUITE.json "
                          "from this same tree, NOT a fresh run"}
-    except Exception:
+    except Exception:  # unreadable/alien suite file: no replay row
         return None
 
 
@@ -886,7 +886,7 @@ def main() -> int:
             try:
                 with open(path) as f:
                     merged = json.load(f)
-            except Exception:
+            except Exception:  # corrupt earlier suite: overwrite fresh
                 pass
         merged.update(extra)
         with open(path, "w") as f:

@@ -1,0 +1,24 @@
+"""graftlint: JAX/TPU-aware static analysis for this framework.
+
+The paper's value proposition is a *correct* SPMD hot path, and the
+hazard classes that break it — host syncs inside per-step loops (the
+reference's own ``.item()`` bug, ref classif.py:61-62), impure
+computation inside traced functions, mismatched collective axis names,
+reused PRNG keys, missing buffer donation, unlocked thread-shared
+state — are invisible to pytest but mechanically detectable.  This
+package is the detector:
+
+  * :mod:`core` — findings, the ``# graftlint:`` pragma grammar,
+    project loading, human/JSON reports;
+  * :mod:`rules` — the rule catalog (see ``rules.RULES``);
+  * :mod:`transfer_guard` — the runtime sanitizer leg: a 1-epoch CPU
+    smoke under ``jax.transfer_guard`` that catches silent device->host
+    transfers the static pass cannot see.
+
+Entry points: ``python main.py lint`` and ``scripts/graftlint.py``
+(static pass, exit 0 = clean), ``scripts/graftlint.py --smoke``
+(sanitizer).  Both gate in ``scripts/gate.sh``.
+"""
+
+from .core import Finding, Project, lint_paths, render_findings  # noqa: F401
+from .rules import RULES  # noqa: F401

@@ -1,0 +1,294 @@
+"""graftlint core: findings, the ``# graftlint:`` pragma grammar, project
+loading, and report rendering.
+
+Pragma grammar (parsed with ``tokenize`` so strings never false-match):
+
+  # graftlint: disable=<rule>[,<rule>...] -- <rationale>
+      Suppress the named rule(s) on this line (trailing comment) or on
+      the next code line (standalone comment line).  The rationale text
+      after ``--`` is REQUIRED: a suppression that does not say why is
+      itself reported (rule ``bad-suppression``).
+
+  # graftlint: guarded-by=<sync-object> [-- rationale]
+      Declares that the attribute assigned on this line is protected by
+      the named synchronization object/protocol (a lock attribute, or a
+      happens-before edge like ``_queue.join``).  Consumed by the
+      ``thread-shared-state`` rule.
+
+Exit contract (CLI): 0 = no findings, 1 = findings, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: meta-rule: malformed / rationale-less / unknown-rule suppressions.
+BAD_SUPPRESSION = "bad-suppression"
+
+_PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*(?P<kind>disable|guarded-by)\s*=\s*"
+    r"(?P<value>[^#]*?)\s*$")
+_RATIONALE_SPLIT = re.compile(r"\s+--\s+")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+@dataclasses.dataclass
+class Suppression:
+    """A ``disable=`` pragma, resolved to the code line it covers."""
+
+    line: int            # the code line the pragma applies to
+    pragma_line: int     # where the pragma physically sits
+    rules: Tuple[str, ...]
+    rationale: str
+    used: bool = False
+
+
+@dataclasses.dataclass
+class Guard:
+    """A ``guarded-by=`` pragma, resolved to the code line it covers."""
+
+    line: int
+    name: str
+    rationale: str
+
+
+class Module:
+    """One parsed source file plus its pragmas."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions: List[Suppression] = []
+        self.guards: Dict[int, Guard] = {}
+        self.comment_lines: Dict[int, str] = {}
+        self.bad_pragmas: List[Tuple[int, str]] = []
+        self._scan_pragmas()
+
+    @property
+    def basename(self) -> str:
+        return os.path.basename(self.path)
+
+    def _scan_pragmas(self) -> None:
+        comments: List[Tuple[int, int, str]] = []  # (line, col, text)
+        code_lines = set()
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.source).readline):
+                if tok.type == tokenize.COMMENT:
+                    comments.append((tok.start[0], tok.start[1],
+                                     tok.string))
+                elif tok.type not in (tokenize.NL, tokenize.NEWLINE,
+                                      tokenize.INDENT, tokenize.DEDENT,
+                                      tokenize.ENDMARKER):
+                    code_lines.add(tok.start[0])
+        except tokenize.TokenError:  # torn file: pragmas best-effort only
+            pass
+        sorted_code = sorted(code_lines)
+
+        def effective_line(comment_line: int) -> int:
+            if comment_line in code_lines:
+                return comment_line        # trailing comment
+            for ln in sorted_code:         # standalone: next code line
+                if ln > comment_line:
+                    return ln
+            return comment_line
+
+        for line, _col, text in comments:
+            self.comment_lines[line] = text
+            m = _PRAGMA_RE.search(text)
+            if not m:
+                if "graftlint:" in text:
+                    self.bad_pragmas.append(
+                        (line, f"unparseable graftlint pragma: {text!r}"))
+                continue
+            kind, value = m.group("kind"), m.group("value")
+            parts = _RATIONALE_SPLIT.split(value, maxsplit=1)
+            payload = parts[0].strip()
+            rationale = parts[1].strip() if len(parts) > 1 else ""
+            target = effective_line(line)
+            if kind == "guarded-by":
+                if not payload:
+                    self.bad_pragmas.append(
+                        (line, "guarded-by pragma names no sync object"))
+                    continue
+                self.guards[target] = Guard(target, payload, rationale)
+                continue
+            rules = tuple(r.strip() for r in payload.split(",")
+                          if r.strip())
+            if not rules:
+                self.bad_pragmas.append(
+                    (line, "disable pragma names no rule"))
+                continue
+            if not rationale:
+                self.bad_pragmas.append(
+                    (line, f"disable={','.join(rules)} has no rationale "
+                           f"(write '-- <why this is safe>')"))
+                continue
+            self.suppressions.append(
+                Suppression(target, line, rules, rationale))
+
+    def has_comment(self, line: int) -> bool:
+        """A human comment on ``line`` or the line above (rationale for
+        the bare-except rule)."""
+        return line in self.comment_lines or \
+            (line - 1) in self.comment_lines
+
+
+class Project:
+    """The set of modules one lint run sees (rules may cross-reference,
+    e.g. axis declarations vs collective uses, config defs vs reads)."""
+
+    def __init__(self, modules: Sequence[Module]):
+        self.modules = list(modules)
+
+    def by_basename(self, name: str) -> List[Module]:
+        return [m for m in self.modules if m.basename == name]
+
+
+# -- file discovery ----------------------------------------------------
+
+#: Default lint scope, relative to the repo root: the package, the entry
+#: points, the bench harness, and the scripts — NOT tests/ (fixtures
+#: trigger rules deliberately).
+DEFAULT_SCOPE = ("distributedpytorch_tpu", "main.py", "bench.py",
+                 "__graft_entry__.py", "scripts")
+
+_SKIP_DIRS = {"__pycache__", ".git", ".ruff_cache"}
+
+
+def discover(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in _SKIP_DIRS]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        elif p.endswith(".py") and os.path.exists(p):
+            out.append(p)
+    return out
+
+
+def load_project(paths: Iterable[str], root: Optional[str] = None
+                 ) -> Tuple[Project, List[Finding]]:
+    """Parse every file; unparseable files become findings, not crashes."""
+    root = root or os.getcwd()
+    modules: List[Module] = []
+    findings: List[Finding] = []
+    for path in discover(paths):
+        rel = os.path.relpath(path, root)
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            modules.append(Module(path, rel, source))
+        except (OSError, SyntaxError, ValueError) as e:
+            findings.append(Finding("parse-error", rel,
+                                    getattr(e, "lineno", 0) or 0,
+                                    f"cannot parse: {e}"))
+    return Project(modules), findings
+
+
+# -- the lint driver ---------------------------------------------------
+
+def lint_project(project: Project, rules=None) -> List[Finding]:
+    from . import rules as rules_mod
+
+    active = rules if rules is not None else rules_mod.RULES
+    raw: List[Finding] = []
+    for rule in active:
+        raw.extend(rule.check(project))
+
+    by_rel = {m.rel: m for m in project.modules}
+    kept: List[Finding] = []
+    for f in sorted(set(raw), key=lambda f: (f.path, f.line, f.rule)):
+        mod = by_rel.get(f.path)
+        suppressed = False
+        if mod is not None and f.rule != BAD_SUPPRESSION:
+            for s in mod.suppressions:
+                if s.line == f.line and f.rule in s.rules:
+                    s.used = True
+                    suppressed = True
+        if not suppressed:
+            kept.append(f)
+
+    rule_names = {r.name for r in active} | {BAD_SUPPRESSION,
+                                             "parse-error"}
+    for mod in project.modules:
+        for line, msg in mod.bad_pragmas:
+            kept.append(Finding(BAD_SUPPRESSION, mod.rel, line, msg))
+        for s in mod.suppressions:
+            unknown = [r for r in s.rules if r not in rule_names]
+            if unknown:
+                kept.append(Finding(
+                    BAD_SUPPRESSION, mod.rel, s.pragma_line,
+                    f"disable names unknown rule(s): "
+                    f"{', '.join(unknown)}"))
+    return sorted(set(kept), key=lambda f: (f.path, f.line, f.rule))
+
+
+def lint_paths(paths: Iterable[str], root: Optional[str] = None,
+               rules=None) -> Tuple[List[Finding], int]:
+    """Lint ``paths``; returns (findings, files_scanned)."""
+    project, parse_findings = load_project(paths, root)
+    findings = parse_findings + lint_project(project, rules)
+    return (sorted(set(findings), key=lambda f: (f.path, f.line, f.rule)),
+            len(project.modules))
+
+
+# -- rendering ---------------------------------------------------------
+
+def render_findings(findings: Sequence[Finding], files: int,
+                    as_json: bool = False) -> str:
+    if as_json:
+        return json.dumps(
+            {"version": 1, "files": files,
+             "findings": [f.to_json() for f in findings]},
+            indent=2, sort_keys=True)
+    if not findings:
+        return f"graftlint: {files} file(s) clean"
+    lines = [f.render() for f in findings]
+    lines.append(f"graftlint: {len(findings)} finding(s) in "
+                 f"{len({f.path for f in findings})} file(s) "
+                 f"({files} scanned)")
+    return "\n".join(lines)
+
+
+def run_cli(argv: Optional[Sequence[str]] = None,
+            json_output: bool = False,
+            paths: Optional[Sequence[str]] = None,
+            root: Optional[str] = None) -> int:
+    """Shared CLI body for ``main.py lint`` and ``scripts/graftlint.py``."""
+    root = root or os.getcwd()
+    scope = [os.path.join(root, p) for p in DEFAULT_SCOPE] \
+        if not paths else list(paths)
+    findings, files = lint_paths(scope, root=root)
+    print(render_findings(findings, files, as_json=json_output))
+    return 1 if findings else 0

@@ -1,0 +1,905 @@
+"""The graftlint rule catalog — eight framework-specific AST rules.
+
+Each rule is an object with ``name``, ``description`` and
+``check(project) -> Iterator[Finding]``.  Rules are deliberately
+repo-aware (they know the step-driving modules, the mesh constructors,
+the thread-spawning classes) — this is a framework linter, not a
+general-purpose one.  Every rule has positive and negative fixtures in
+tests/test_graftlint.py.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import Finding, Module, Project
+
+
+# -- shared AST helpers ------------------------------------------------
+
+def dotted(node: ast.AST) -> str:
+    """Best-effort dotted name for a call target / reference:
+    ``jax.lax.psum`` -> "jax.lax.psum", ``self._apply`` -> "self._apply",
+    anything unresolvable -> ""."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif not parts:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def call_name(call: ast.Call) -> str:
+    return dotted(call.func)
+
+
+def last_seg(name: str) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def root_seg(name: str) -> str:
+    return name.split(".", 1)[0] if name else ""
+
+
+def walk_calls(node: ast.AST) -> Iterator[ast.Call]:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            yield n
+
+
+def kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class Rule:
+    name = ""
+    description = ""
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, mod: Module, line: int, message: str) -> Finding:
+        return Finding(self.name, mod.rel, line, message)
+
+
+# -- 1. host-sync-in-step-loop ----------------------------------------
+
+class HostSyncInStepLoop(Rule):
+    """The paper's own bug class (ref classif.py:61-62 per-batch
+    ``.item()``): a blocking device->host sync inside a per-step loop
+    serializes the host against every dispatch.  Per-epoch syncs are
+    fine; per-batch ones are findings.  Applies to the step-driving
+    modules (train/engine.py, cli.py and fixtures named like them)."""
+
+    name = "host-sync-in-step-loop"
+    description = ("jax.device_get/.item()/float()/np.asarray() inside "
+                   "a per-step loop (per-epoch is allowed)")
+    TARGET_BASENAMES = {"engine.py", "cli.py"}
+
+    def _is_step_iter(self, node: ast.expr) -> bool:
+        """``for ... in loader.epoch(e)`` / ``enumerate(loader.epoch(e))``
+        / ``range(...batches_per_epoch...)`` style iterators."""
+        for call in walk_calls(node):
+            cn = call_name(call)
+            if last_seg(cn) in ("epoch", "_threaded_epoch",
+                                "_host_batches"):
+                return True
+            if last_seg(cn) == "range" and any(
+                    "batches_per_epoch" in dotted(a) or
+                    "nb_iters" in dotted(a)
+                    for a in ast.walk(call) if isinstance(
+                        a, (ast.Name, ast.Attribute))):
+                return True
+        return False
+
+    def _sync_calls(self, body: List[ast.stmt]
+                    ) -> Iterator[Tuple[int, str]]:
+        for stmt in body:
+            for call in walk_calls(stmt):
+                cn = call_name(call)
+                if last_seg(cn) == "device_get":
+                    yield call.lineno, f"{cn}() blocks on device values"
+                elif last_seg(cn) == "item" and not call.args:
+                    yield (call.lineno,
+                           ".item() forces a device sync every step")
+                elif cn in ("float", "int") and call.args:
+                    yield (call.lineno,
+                           f"{cn}() on a device value blocks; keep "
+                           f"per-step metrics on device")
+                elif cn in ("np.asarray", "np.array", "numpy.asarray",
+                            "numpy.array"):
+                    yield (call.lineno,
+                           f"{cn}() copies device->host every step")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            if mod.basename not in self.TARGET_BASENAMES:
+                continue
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.For) \
+                        and self._is_step_iter(node.iter):
+                    for line, msg in self._sync_calls(node.body):
+                        yield self.finding(
+                            mod, line,
+                            f"host sync in per-step loop: {msg} "
+                            f"(accumulate on device, sync per epoch)")
+
+
+# -- 2. trace-impurity -------------------------------------------------
+
+_IMPURE_CALLS = {"np.asarray", "np.array", "numpy.asarray",
+                 "numpy.array", "np.copy"}
+_IMPURE_ROOTS = {"time", "logging", "telemetry", "tel"}
+
+
+class TraceImpurity(Rule):
+    """Side effects inside jit/pjit/shard_map-traced functions run at
+    TRACE time (once, on abstract values), not per step — prints and
+    clocks silently measure nothing, numpy materializes tracers, and
+    attribute/nonlocal mutation leaks trace-time state."""
+
+    name = "trace-impurity"
+    description = ("print/time/logging/telemetry/np-materialization or "
+                   "nonlocal mutation inside a traced function")
+
+    _WRAPPERS = {"jit", "pjit", "shard_map"}
+
+    def _partial_target(self, node: ast.expr) -> Optional[str]:
+        if isinstance(node, ast.Call) \
+                and last_seg(call_name(node)) == "partial" and node.args:
+            return dotted(node.args[0])
+        return None
+
+    def _wrapped_name(self, node: ast.expr,
+                      local_partials: Dict[str, str]) -> Optional[str]:
+        """The function name a jit/shard_map call wraps, if resolvable:
+        a Name, ``self.x``, ``functools.partial(f, ...)``, or a local
+        variable previously bound to a partial."""
+        target = self._partial_target(node)
+        if target:
+            return last_seg(target)
+        name = dotted(node)
+        if name:
+            short = last_seg(name)
+            return local_partials.get(short, short)
+        if isinstance(node, ast.Call) \
+                and last_seg(call_name(node)) in self._WRAPPERS \
+                and node.args:
+            return self._wrapped_name(node.args[0], local_partials)
+        return None
+
+    def _collect_traced_roots(self, mod: Module) -> Set[str]:
+        roots: Set[str] = set()
+        # local `x = functools.partial(f, ...)` bindings, module-wide
+        local_partials: Dict[str, str] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                t = self._partial_target(node.value)
+                if t:
+                    local_partials[node.targets[0].id] = last_seg(t)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    dn = dotted(dec)
+                    if last_seg(dn) in self._WRAPPERS:
+                        roots.add(node.name)
+                    elif isinstance(dec, ast.Call):
+                        cn = call_name(dec)
+                        if last_seg(cn) in self._WRAPPERS:
+                            roots.add(node.name)
+                        elif last_seg(cn) == "partial" and dec.args \
+                                and last_seg(dotted(dec.args[0])) \
+                                in self._WRAPPERS:
+                            roots.add(node.name)
+            elif isinstance(node, ast.Call) \
+                    and last_seg(call_name(node)) in self._WRAPPERS \
+                    and node.args:
+                wrapped = self._wrapped_name(node.args[0],
+                                             local_partials)
+                if wrapped:
+                    roots.add(wrapped)
+        return roots
+
+    def _function_table(self, mod: Module
+                        ) -> Dict[str, ast.FunctionDef]:
+        table: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                table.setdefault(node.name, node)
+        return table
+
+    def _expand(self, roots: Set[str],
+                table: Dict[str, ast.FunctionDef]) -> Set[str]:
+        """Transitive closure: any function of this module *referenced*
+        from a traced body (called directly, via self.x, or passed to
+        scan/vmap/partial) is traced too."""
+        traced = set(r for r in roots if r in table)
+        frontier = list(traced)
+        while frontier:
+            fn = table[frontier.pop()]
+            for node in ast.walk(fn):
+                ref = None
+                if isinstance(node, ast.Attribute):
+                    ref = node.attr
+                elif isinstance(node, ast.Name):
+                    ref = node.id
+                if ref and ref in table and ref not in traced:
+                    traced.add(ref)
+                    frontier.append(ref)
+        return traced
+
+    def _impure(self, fn: ast.FunctionDef) -> Iterator[Tuple[int, str]]:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                cn = call_name(node)
+                if cn == "print":
+                    yield node.lineno, "print() runs at trace time only"
+                elif root_seg(cn) in _IMPURE_ROOTS and "." in cn:
+                    yield (node.lineno,
+                           f"{cn}() is a host side effect; it runs at "
+                           f"trace time, not per step")
+                elif cn in _IMPURE_CALLS:
+                    yield (node.lineno,
+                           f"{cn}() materializes tracers on host")
+                elif last_seg(cn) == "device_get":
+                    yield node.lineno, f"{cn}() on a tracer"
+            elif isinstance(node, (ast.Nonlocal, ast.Global)):
+                kind = ("nonlocal" if isinstance(node, ast.Nonlocal)
+                        else "global")
+                yield (node.lineno,
+                       f"{kind} mutation from a traced function leaks "
+                       f"trace-time state")
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        yield (node.lineno,
+                               f"self.{t.attr} assignment inside a "
+                               f"traced function runs once at trace "
+                               f"time")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            table = self._function_table(mod)
+            traced = self._expand(self._collect_traced_roots(mod), table)
+            for name in sorted(traced):
+                for line, msg in self._impure(table[name]):
+                    yield self.finding(
+                        mod, line, f"in traced function {name!r}: {msg}")
+
+
+# -- 3. collective-axis-consistency -----------------------------------
+
+_COLLECTIVES = {"psum": 1, "pmean": 1, "pmax": 1, "pmin": 1,
+                "all_gather": 1, "ppermute": 1, "psum_scatter": 1,
+                "all_to_all": 1, "axis_index": 0}
+
+
+class CollectiveAxisConsistency(Rule):
+    """Every ``lax.psum/pmean/all_gather/ppermute/axis_index`` axis name
+    must be an axis some mesh constructor declares (runtime.make_mesh's
+    data/model/seq, or any literal ``Mesh(..., (names...))``) — a typo'd
+    axis surfaces as an unbound-axis error only for the configs that
+    reach that code path."""
+
+    name = "collective-axis-consistency"
+    description = "collective axis names must match declared mesh axes"
+
+    def _declared_axes(self, project: Project) -> Set[str]:
+        axes: Set[str] = set()
+        for mod in project.modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id.endswith("_AXIS") \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, str):
+                    axes.add(node.value.value)
+                elif isinstance(node, ast.Call) \
+                        and last_seg(call_name(node)) == "Mesh":
+                    cands = list(node.args[1:2]) + [
+                        v for v in (kwarg(node, "axis_names"),)
+                        if v is not None]
+                    for cand in cands:
+                        if isinstance(cand, (ast.Tuple, ast.List)):
+                            for el in cand.elts:
+                                if isinstance(el, ast.Constant) \
+                                        and isinstance(el.value, str):
+                                    axes.add(el.value)
+        return axes
+
+    def _axis_constants(self, project: Project) -> Dict[str, str]:
+        consts: Dict[str, str] = {}
+        for mod in project.modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id.endswith("_AXIS") \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, str):
+                    consts[node.targets[0].id] = node.value.value
+        return consts
+
+    def _param_defaults(self, mod: Module) -> Dict[Tuple[str, str], str]:
+        """(function, param) -> string default, for axis args passed by
+        parameter (``def f(..., axis_name='model')``)."""
+        out: Dict[Tuple[str, str], str] = {}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            a = node.args
+            pos = a.posonlyargs + a.args
+            for param, default in zip(pos[len(pos) - len(a.defaults):],
+                                      a.defaults):
+                if isinstance(default, ast.Constant) \
+                        and isinstance(default.value, str):
+                    out[(node.name, param.arg)] = default.value
+            for param, default in zip(a.kwonlyargs, a.kw_defaults):
+                if isinstance(default, ast.Constant) \
+                        and isinstance(default.value, str):
+                    out[(node.name, param.arg)] = default.value
+        return out
+
+    def _resolve(self, node: ast.expr, consts: Dict[str, str],
+                 enclosing: Optional[str],
+                 defaults: Dict[Tuple[str, str], str]) -> Optional[str]:
+        if isinstance(node, ast.Constant) \
+                and isinstance(node.value, str):
+            return node.value
+        name = dotted(node)
+        if last_seg(name) in consts:
+            return consts[last_seg(name)]
+        if enclosing and isinstance(node, ast.Name):
+            return defaults.get((enclosing, node.id))
+        return None
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        declared = self._declared_axes(project)
+        consts = self._axis_constants(project)
+        for mod in project.modules:
+            defaults = self._param_defaults(mod)
+            # map each call to its enclosing function for param defaults
+            enclosing: Dict[int, str] = {}
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Call):
+                            enclosing.setdefault(id(sub), node.name)
+            for call in walk_calls(mod.tree):
+                cn = call_name(call)
+                seg = last_seg(cn)
+                if seg not in _COLLECTIVES or "lax" not in cn:
+                    continue
+                pos = _COLLECTIVES[seg]
+                axis_arg = kwarg(call, "axis_name")
+                if axis_arg is None and len(call.args) > pos:
+                    axis_arg = call.args[pos]
+                if axis_arg is None:
+                    continue
+                axis = self._resolve(axis_arg, consts,
+                                     enclosing.get(id(call)), defaults)
+                if axis is not None and axis not in declared:
+                    yield self.finding(
+                        mod, call.lineno,
+                        f"{cn}(axis {axis!r}) names an axis no mesh "
+                        f"constructor declares "
+                        f"(declared: {sorted(declared)})")
+
+
+# -- 4. prng-reuse -----------------------------------------------------
+
+_KEY_MAKERS = {"PRNGKey", "key", "split", "fold_in", "fold_key",
+               "root_key", "clone"}
+_KEY_DERIVERS = {"split", "fold_in", "fold_key", "PRNGKey", "key",
+                 "root_key", "clone", "key_data", "wrap_key_data"}
+
+
+class PrngReuse(Rule):
+    """A PRNGKey consumed twice without an intervening split/fold_in
+    draws IDENTICAL randomness at both sites — augmentation noise,
+    dropout masks, init values silently correlate."""
+
+    name = "prng-reuse"
+    description = ("PRNGKey variable consumed by two samplers without "
+                   "an intervening split/fold_in")
+
+    def _key_vars(self, fn: ast.FunctionDef) -> Set[str]:
+        keys: Set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            if isinstance(node.value, ast.Call) \
+                    and last_seg(call_name(node.value)) in _KEY_MAKERS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        keys.add(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        keys.update(e.id for e in t.elts
+                                    if isinstance(e, ast.Name))
+        return keys
+
+    def _consumptions(self, stmt: ast.stmt, keys: Set[str]
+                      ) -> List[Tuple[str, int]]:
+        """Key consumptions in one statement: a key passed to a
+        jax.random sampler, or inside an ``rngs=`` mapping, or in a
+        dict handed to ``.init``/``.apply``."""
+        out: List[Tuple[str, int]] = []
+        for call in walk_calls(stmt):
+            cn = call_name(call)
+            seg = last_seg(cn)
+            if "random" in cn and seg not in _KEY_DERIVERS:
+                for arg in call.args:
+                    if isinstance(arg, ast.Name) and arg.id in keys:
+                        out.append((arg.id, arg.lineno))
+            rngs = kwarg(call, "rngs")
+            if rngs is not None:
+                for used in names_in(rngs) & keys:
+                    out.append((used, rngs.lineno))
+            if seg in ("init", "apply"):
+                for arg in call.args:
+                    if isinstance(arg, ast.Dict):
+                        for v in arg.values:
+                            if isinstance(v, ast.Name) and v.id in keys:
+                                out.append((v.id, v.lineno))
+        return out
+
+    def _assigned(self, stmt: ast.stmt) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    names.update(names_in(t))
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) \
+                    and node.target is not None:
+                names.update(names_in(node.target))
+        return names
+
+    def _scan(self, body: List[ast.stmt], keys: Set[str],
+              counts: Dict[str, int], out: List[Tuple[str, int]],
+              in_loop: bool = False) -> None:
+        for stmt in body:
+            if isinstance(stmt, ast.If):
+                base = dict(counts)
+                branches = []
+                for branch in (stmt.body, stmt.orelse):
+                    c = dict(base)
+                    self._scan(branch, keys, c, out, in_loop)
+                    branches.append(c)
+                for k in keys:
+                    counts[k] = max(b.get(k, 0) for b in branches)
+                continue
+            if isinstance(stmt, (ast.For, ast.While)):
+                # loop body runs "twice": consumption of an outer key on
+                # each iteration is reuse, unless re-derived inside
+                for _ in range(2):
+                    self._scan(stmt.body, keys, counts, out,
+                               in_loop=True)
+                self._scan(stmt.orelse, keys, counts, out, in_loop)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._scan(stmt.body, keys, counts, out, in_loop)
+                for h in stmt.handlers:
+                    self._scan(h.body, keys, counts, out, in_loop)
+                self._scan(stmt.orelse, keys, counts, out, in_loop)
+                self._scan(stmt.finalbody, keys, counts, out, in_loop)
+                continue
+            if isinstance(stmt, ast.With):
+                self._scan(stmt.body, keys, counts, out, in_loop)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested defs analyzed separately
+            for var, line in self._consumptions(stmt, keys):
+                counts[var] = counts.get(var, 0) + 1
+                if counts[var] == 2:
+                    out.append((var, line))
+            for var in self._assigned(stmt) & keys:
+                counts[var] = 0  # rebound: a fresh key
+        return None
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                keys = self._key_vars(node)
+                if not keys:
+                    continue
+                reused: List[Tuple[str, int]] = []
+                self._scan(node.body, keys, {}, reused)
+                for var, line in reused:
+                    yield self.finding(
+                        mod, line,
+                        f"PRNG key {var!r} consumed twice without an "
+                        f"intervening split/fold_in — both sites draw "
+                        f"identical randomness")
+
+
+# -- 5. missing-donation ----------------------------------------------
+
+class MissingDonation(Rule):
+    """A jitted train step that takes a TrainState without donating it
+    holds TWO copies of params+optimizer state live across the update —
+    the single biggest avoidable HBM cost in a training loop."""
+
+    name = "missing-donation"
+    description = ("jitted train-step-like function (TrainState first "
+                   "arg) without donate_argnums")
+
+    def _defs(self, mod: Module) -> Dict[str, ast.FunctionDef]:
+        return {n.name: n for n in ast.walk(mod.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    def _train_state_first_arg(self, fn: ast.FunctionDef) -> bool:
+        args = [a for a in fn.args.posonlyargs + fn.args.args
+                if a.arg != "self"]
+        if not args:
+            return False
+        first = args[0]
+        ann = dotted(first.annotation) if first.annotation else ""
+        return first.arg == "state" or last_seg(ann) == "TrainState"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            defs = self._defs(mod)
+            for call in walk_calls(mod.tree):
+                if last_seg(call_name(call)) not in ("jit", "pjit"):
+                    continue
+                if kwarg(call, "donate_argnums") is not None \
+                        or kwarg(call, "donate_argnames") is not None:
+                    continue
+                if not call.args:
+                    continue
+                wrapped = last_seg(dotted(call.args[0]))
+                fn = defs.get(wrapped)
+                if fn is None or "train" not in fn.name:
+                    continue
+                if self._train_state_first_arg(fn):
+                    yield self.finding(
+                        mod, call.lineno,
+                        f"jit({fn.name}) takes a TrainState but does "
+                        f"not donate it: two copies of params+opt "
+                        f"state stay live across the update (add "
+                        f"donate_argnums=0)")
+            # decorator form: @jax.jit / @partial(jax.jit, ...) on a def
+            for fn in defs.values():
+                if "train" not in fn.name \
+                        or not self._train_state_first_arg(fn):
+                    continue
+                for dec in fn.decorator_list:
+                    if last_seg(dotted(dec)) in ("jit", "pjit"):
+                        yield self.finding(
+                            mod, fn.lineno,
+                            f"@jit on {fn.name} without donate_argnums "
+                            f"(TrainState is copied, not reused)")
+                    elif isinstance(dec, ast.Call) \
+                            and last_seg(call_name(dec)) in ("jit",
+                                                             "pjit") \
+                            and kwarg(dec, "donate_argnums") is None \
+                            and kwarg(dec, "donate_argnames") is None:
+                        yield self.finding(
+                            mod, fn.lineno,
+                            f"@jit on {fn.name} without donate_argnums "
+                            f"(TrainState is copied, not reused)")
+
+
+# -- 6. thread-shared-state -------------------------------------------
+
+_THREADSAFE_TYPES = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+                     "Event", "Lock", "RLock", "Condition", "Semaphore",
+                     "BoundedSemaphore", "Barrier", "local", "deque"}
+
+
+class ThreadSharedState(Rule):
+    """In a class that spawns ``threading.Thread``, an attribute written
+    by the thread target and read elsewhere without the class's lock (or
+    a ``# graftlint: guarded-by=<sync>`` annotation at its __init__
+    assignment) is a data race candidate."""
+
+    name = "thread-shared-state"
+    description = ("attribute written in a thread target, read "
+                   "elsewhere without lock or guarded-by annotation")
+
+    def _methods(self, cls: ast.ClassDef) -> Dict[str, ast.FunctionDef]:
+        return {n.name: n for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    def _thread_targets(self, cls: ast.ClassDef
+                        ) -> List[ast.FunctionDef]:
+        """Functions handed to threading.Thread(target=...): methods
+        (``self.x``) or nested defs of the spawning method."""
+        out: List[ast.FunctionDef] = []
+        methods = self._methods(cls)
+        for meth in methods.values():
+            nested = {n.name: n for n in ast.walk(meth)
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+                      and n is not meth}
+            for call in walk_calls(meth):
+                if last_seg(call_name(call)) != "Thread":
+                    continue
+                target = kwarg(call, "target")
+                if target is None:
+                    continue
+                tn = last_seg(dotted(target))
+                if tn in methods:
+                    out.append(methods[tn])
+                elif tn in nested:
+                    out.append(nested[tn])
+        return out
+
+    def _self_attr_writes(self, fn: ast.FunctionDef) -> Dict[str, int]:
+        writes: Dict[str, int] = {}
+        for node in ast.walk(fn):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    writes.setdefault(t.attr, node.lineno)
+        return writes
+
+    def _lock_attrs(self, cls: ast.ClassDef) -> Set[str]:
+        locks: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and last_seg(call_name(node.value)) in (
+                        "Lock", "RLock", "Condition"):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        locks.add(t.attr)
+        return locks
+
+    def _exempt_attrs(self, cls: ast.ClassDef, mod: Module) -> Set[str]:
+        """Attrs of inherently thread-safe type, or annotated
+        guarded-by at any of their assignments."""
+        exempt: Set[str] = set()
+        for node in ast.walk(cls):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            else:
+                continue
+            for t in targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                value = node.value
+                if isinstance(value, ast.Call) and last_seg(
+                        call_name(value)) in _THREADSAFE_TYPES:
+                    exempt.add(t.attr)
+                if node.lineno in mod.guards:
+                    exempt.add(t.attr)
+        return exempt
+
+    def _unguarded_accesses(self, fn: ast.FunctionDef, attr: str,
+                            locks: Set[str]) -> List[int]:
+        """Accesses to self.<attr> in ``fn`` outside every
+        ``with self.<lock>:`` block."""
+        guarded_ranges: List[Tuple[int, int]] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    d = dotted(item.context_expr)
+                    if d.startswith("self.") \
+                            and d.split(".")[1] in locks:
+                        guarded_ranges.append(
+                            (node.lineno, node.end_lineno or node.lineno))
+        lines: List[int] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Attribute) and node.attr == attr \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self":
+                if not any(a <= node.lineno <= b
+                           for a, b in guarded_ranges):
+                    lines.append(node.lineno)
+        return lines
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            for cls in ast.walk(mod.tree):
+                if not isinstance(cls, ast.ClassDef):
+                    continue
+                targets = self._thread_targets(cls)
+                if not targets:
+                    continue
+                locks = self._lock_attrs(cls)
+                exempt = self._exempt_attrs(cls, mod)
+                target_names = {t.name for t in targets}
+                for target in targets:
+                    for attr, wline in sorted(
+                            self._self_attr_writes(target).items()):
+                        if attr in exempt:
+                            continue
+                        for meth in self._methods(cls).values():
+                            if meth.name in target_names:
+                                continue
+                            for line in self._unguarded_accesses(
+                                    meth, attr, locks):
+                                yield self.finding(
+                                    mod, line,
+                                    f"self.{attr} is written by thread "
+                                    f"target {target.name!r} (line "
+                                    f"{wline}) but accessed in "
+                                    f"{meth.name!r} without holding a "
+                                    f"class lock; lock it or annotate "
+                                    f"the __init__ assignment with "
+                                    f"'# graftlint: guarded-by=<sync>'")
+
+
+# -- 7. config-drift ---------------------------------------------------
+
+class ConfigDrift(Rule):
+    """config.py constants, Config dataclass fields, and argparse dests
+    that are defined but never read anywhere — dead configuration
+    surface that silently diverges from behavior."""
+
+    name = "config-drift"
+    description = ("config constant / Config field / CLI dest defined "
+                   "but never read")
+
+    def _config_defs(self, mod: Module):
+        constants: Dict[str, int] = {}
+        fields: Dict[str, int] = {}
+        dests: Dict[str, int] = {}
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id.isupper():
+                constants[node.targets[0].id] = node.lineno
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "Config":
+                for stmt in node.body:
+                    if isinstance(stmt, ast.AnnAssign) \
+                            and isinstance(stmt.target, ast.Name):
+                        fields[stmt.target.id] = stmt.lineno
+            elif isinstance(node, ast.Call) \
+                    and last_seg(call_name(node)) == "add_argument":
+                dest = kwarg(node, "dest")
+                if isinstance(dest, ast.Constant) \
+                        and isinstance(dest.value, str):
+                    dests[dest.value] = node.lineno
+                elif dest is None:
+                    longs = [a.value for a in node.args
+                             if isinstance(a, ast.Constant)
+                             and isinstance(a.value, str)
+                             and a.value.startswith("--")]
+                    if longs:
+                        dests[longs[0][2:].replace("-", "_")] = \
+                            node.lineno
+        return constants, fields, dests
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.by_basename("config.py"):
+            constants, fields, dests = self._config_defs(mod)
+            used_names: Set[str] = set()
+            used_attrs: Set[str] = set()
+            getattr_strings: Set[str] = set()
+            for other in project.modules:
+                for node in ast.walk(other.tree):
+                    if isinstance(node, ast.Name) \
+                            and isinstance(node.ctx, ast.Load):
+                        used_names.add(node.id)
+                    elif isinstance(node, ast.Attribute) \
+                            and isinstance(node.ctx, ast.Load):
+                        used_attrs.add(node.attr)
+                    elif isinstance(node, ast.Call) \
+                            and dotted(node.func) == "getattr" \
+                            and len(node.args) >= 2 \
+                            and isinstance(node.args[1], ast.Constant) \
+                            and isinstance(node.args[1].value, str):
+                        getattr_strings.add(node.args[1].value)
+            for name, line in sorted(constants.items()):
+                if name not in used_names:
+                    yield self.finding(
+                        mod, line,
+                        f"constant {name} is defined but never read "
+                        f"(delete it or wire it)")
+            for name, line in sorted(fields.items()):
+                # construction keywords don't count: a field that is
+                # parsed+stored but never READ is exactly the drift
+                if name not in used_attrs \
+                        and name not in getattr_strings:
+                    yield self.finding(
+                        mod, line,
+                        f"Config field {name!r} is never read — dead "
+                        f"configuration surface (delete or plumb it)")
+            for name, line in sorted(dests.items()):
+                if name not in used_attrs \
+                        and name not in getattr_strings:
+                    yield self.finding(
+                        mod, line,
+                        f"CLI flag dest {name!r} is parsed but never "
+                        f"consumed (delete the flag or plumb it)")
+
+
+# -- 8. bare-except ----------------------------------------------------
+
+class BareExcept(Rule):
+    """``except Exception:`` / bare ``except:`` without a rationale
+    comment swallows real defects (and keyboard interrupts, for the
+    bare form).  Narrow the type, or say WHY broad is right, on the
+    except line or the line above."""
+
+    name = "bare-except"
+    description = ("except Exception / bare except without a rationale "
+                   "comment")
+
+    def _is_broad(self, handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True
+        if isinstance(t, (ast.Name, ast.Attribute)):
+            return last_seg(dotted(t)) in ("Exception", "BaseException")
+        if isinstance(t, ast.Tuple):
+            return any(last_seg(dotted(e)) in ("Exception",
+                                               "BaseException")
+                       for e in t.elts)
+        return False
+
+    def _has_rationale(self, mod: Module,
+                       handler: ast.ExceptHandler) -> bool:
+        """A comment on the except line, the line above, or leading the
+        handler body (before/at its first statement)."""
+        if mod.has_comment(handler.lineno):
+            return True
+        first_body = handler.body[0].lineno if handler.body \
+            else handler.lineno
+        return any(ln in mod.comment_lines
+                   for ln in range(handler.lineno + 1, first_body + 1))
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ExceptHandler) \
+                        and self._is_broad(node) \
+                        and not self._has_rationale(mod, node):
+                    what = (ast.unparse(node.type)
+                            if node.type is not None else "bare except")
+                    yield self.finding(
+                        mod, node.lineno,
+                        f"broad handler ({what}) without a rationale "
+                        f"comment — narrow the exception type or say "
+                        f"why broad is correct")
+
+
+RULES = (
+    HostSyncInStepLoop(),
+    TraceImpurity(),
+    CollectiveAxisConsistency(),
+    PrngReuse(),
+    MissingDonation(),
+    ThreadSharedState(),
+    ConfigDrift(),
+    BareExcept(),
+)
+
+RULES_BY_NAME = {r.name: r for r in RULES}

@@ -1,0 +1,189 @@
+"""Transfer-guard sanitizer: a 1-epoch CPU smoke that FAILS on any
+unsanctioned device->host sync.
+
+The static pass (rules.HostSyncInStepLoop) catches the syntactic shapes
+of the paper's per-batch ``.item()`` bug; this leg catches what AST
+cannot see — a sync hidden behind a helper, a library call that
+materializes a device value, an f-string formatting a jax.Array.
+
+Two guard layers run during the smoke epoch:
+
+  * ``jax.transfer_guard_device_to_host("disallow_explicit")`` — jax's
+    native guard.  On TPU/GPU it rejects every implicit AND explicit
+    device->host transfer; on the CPU backend it is VACUOUS (a CPU
+    buffer is already host memory, so jax records no transfer — probed
+    and pinned in tests/test_transfer_guard.py).
+  * the sanitizer's own patched sync primitives —
+    ``jax.device_get``, ``Array.item/__float__/__int__/__index__/
+    __bool__`` raise :class:`HostTransferViolation` unless the calling
+    thread is inside ``runtime.sanctioned_host_transfer()``.  This is
+    what makes the smoke sharp on the CPU backend the gate runs on.
+
+The framework's few legitimate per-epoch sync points (epoch-end metric
+fetches, checkpoint snapshots) wrap themselves in
+``runtime.sanctioned_host_transfer()``, so a clean epoch passes — and
+any OTHER sync fails the smoke instead of silently serializing the hot
+path.  Proven sharp in tests/test_transfer_guard.py: injecting a
+deliberate per-step ``jax.device_get`` into the train loop flips the
+result.
+
+Run it:  python scripts/graftlint.py --smoke   (gate.sh leg;
+JAX_PLATFORMS=cpu is forced so it never needs hardware).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import tempfile
+from typing import Optional
+
+
+class HostTransferViolation(RuntimeError):
+    """An unsanctioned device->host sync during the guarded smoke."""
+
+
+def _check_sanctioned(what: str) -> None:
+    from .. import runtime
+
+    if not runtime.host_transfer_sanctioned():
+        raise HostTransferViolation(
+            f"unsanctioned device->host sync via {what} — per-step host "
+            f"syncs serialize the driver against every dispatch; "
+            f"accumulate on device and sync per epoch (or wrap a "
+            f"legitimate per-epoch sync in "
+            f"runtime.sanctioned_host_transfer())")
+
+
+@contextlib.contextmanager
+def _patched_sync_primitives():
+    """Patch the Python-level sync primitives to consult the sanction
+    marker.  jax.device_get and the ArrayImpl scalar-conversion methods
+    are plain Python attributes (verified on jax 0.4.x); everything is
+    restored on exit, so the patch cannot leak into other tests."""
+    import jax
+    from jax._src.array import ArrayImpl
+
+    orig_get = jax.device_get
+
+    def guarded_device_get(*args, **kwargs):
+        _check_sanctioned("jax.device_get")
+        return orig_get(*args, **kwargs)
+
+    method_names = ("item", "__float__", "__int__", "__index__",
+                    "__bool__")
+    originals = {}
+    for name in method_names:
+        fn = ArrayImpl.__dict__.get(name)
+        if fn is None:
+            continue
+
+        def make(name, fn):
+            def guarded(self, *a, **k):
+                _check_sanctioned(f"Array.{name}")
+                return fn(self, *a, **k)
+            return guarded
+
+        originals[name] = fn
+        setattr(ArrayImpl, name, make(name, fn))
+    jax.device_get = guarded_device_get
+    try:
+        yield
+    finally:
+        jax.device_get = orig_get
+        for name, fn in originals.items():
+            setattr(ArrayImpl, name, fn)
+
+
+def _smoke_config(rsl_path: str):
+    from ..config import Config
+
+    # Streaming mode on the debug-subset synthetic corpus: the per-step
+    # driver loop — exactly the code path the paper's bug class lives in
+    # — with a real checkpoint write at the epoch boundary.
+    return Config(action="train", data_path="/tmp/nodata",
+                  rsl_path=rsl_path, dataset="synthetic",
+                  model_name="mlp", batch_size=8, nb_epochs=1,
+                  debug=True, half_precision=False, data_mode="stream",
+                  prefetch=2, producer_threads=0, no_compile_cache=True)
+
+
+def run_smoke(rsl_path: Optional[str] = None,
+              inject_host_sync: bool = False) -> bool:
+    """One guarded smoke epoch.  Returns True when the epoch completed
+    with no unsanctioned device->host transfer.
+
+    ``inject_host_sync=True`` wraps the engine's train step so every
+    step fetches its metrics to host — the reference's per-batch
+    ``.item()`` bug, mechanically reproduced — and must flip the result
+    to False (pinned in tests/test_transfer_guard.py).
+    """
+    import jax
+
+    from .. import cli
+
+    guard = getattr(jax, "transfer_guard_device_to_host", None)
+    if guard is None:  # very old jax: the patched primitives still guard
+        def guard(_level):
+            return contextlib.nullcontext()
+
+    tmp = None
+    if rsl_path is None:
+        tmp = tempfile.TemporaryDirectory(prefix="graftlint_smoke_")
+        rsl_path = tmp.name
+    cfg = _smoke_config(rsl_path)
+
+    orig_build = cli._build_engine
+
+    def build_and_inject(*args, **kwargs):
+        engine = orig_build(*args, **kwargs)
+        orig_step = engine.train_step
+
+        def leaky_step(*step_args):
+            out = orig_step(*step_args)
+            jax.device_get(out[1])  # the deliberate per-step host sync
+            return out
+
+        engine.train_step = leaky_step
+        return engine
+
+    try:
+        if inject_host_sync:
+            cli._build_engine = build_and_inject
+        with guard("disallow_explicit"), _patched_sync_primitives():
+            result = cli.run_train(cfg)
+    except Exception as e:
+        # Any failure under the guard is a finding: either a disallowed
+        # transfer (the point of the smoke) or a broken smoke config —
+        # both must turn the gate red, with the cause printed.
+        logging.error(f"transfer-guard smoke FAILED: {type(e).__name__}: "
+                      f"{e}")
+        return False
+    finally:
+        cli._build_engine = orig_build
+        if tmp is not None:
+            tmp.cleanup()
+    if len(result["history"]) != 1:
+        logging.error("transfer-guard smoke: run produced no epoch "
+                      "history — smoke did not actually train")
+        return False
+    return True
+
+
+def main() -> int:
+    """CLI entry (scripts/graftlint.py --smoke).  Forces the CPU
+    backend: the smoke is a correctness sanitizer, not a benchmark."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    ok = run_smoke()
+    print("transfer-guard smoke: "
+          + ("PASS (no unsanctioned device->host transfer in a "
+             "streaming epoch)" if ok else "FAIL"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

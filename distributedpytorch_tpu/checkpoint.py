@@ -51,7 +51,7 @@ import numpy as np
 from flax import serialization
 from jax.sharding import NamedSharding, PartitionSpec
 
-from . import telemetry
+from . import runtime, telemetry
 from .models import vit_pipeline
 from .train.engine import TrainState
 
@@ -88,7 +88,8 @@ def gather_replicated(state: TrainState) -> TrainState:
         # re-materializing the full unsharded state (the exact footprint
         # --model-parallel exists to avoid) on every device at save time.
         if isinstance(a, jax.Array) and not a.is_fully_replicated:
-            return jax.device_get(gather(a))
+            with runtime.sanctioned_host_transfer():  # snapshot sync
+                return jax.device_get(gather(a))
         return a
 
     return jax.tree_util.tree_map(_one, state)
@@ -110,13 +111,14 @@ def _msgpack_payload(model_name: str, state: TrainState, epoch: int,
                      best_valid_loss: float) -> dict:
     """The host-side snapshot: everything the file needs, with no live
     device buffers left in it (donation-safe once this returns)."""
+    with runtime.sanctioned_host_transfer():  # checkpoint snapshot sync
+        state_host = jax.device_get(gather_replicated(state))
     return {
         "format_version": _FORMAT_VERSION,
         "model_name": model_name,
         "epoch": int(epoch),
         "loss": float(best_valid_loss),
-        "state": serialization.to_state_dict(
-            jax.device_get(gather_replicated(state))),
+        "state": serialization.to_state_dict(state_host),
     }
 
 
@@ -174,6 +176,11 @@ class AsyncSaver:
 
     def __init__(self):
         self._queue = queue_mod.Queue()
+        # graftlint: guarded-by=_queue.join -- single writer thread sets
+        # it before task_done(); the driver reads it from submit()/wait()
+        # /close(), where a post-join read is ordered by Queue.join and a
+        # pre-join read can at worst miss an exception that the very
+        # next call re-raises (reference assignment is atomic in Python)
         self._exc: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -184,7 +191,8 @@ class AsyncSaver:
                 if fn is _SAVER_SHUTDOWN:
                     return
                 fn()
-            except BaseException as e:
+            except BaseException as e:  # captured for the driver: the
+                # next submit()/wait()/close() re-raises it there
                 self._exc = e
             finally:
                 self._queue.task_done()
@@ -521,7 +529,7 @@ def _load_orbax(path: str, state: TrainState, restore_optimizer: bool
                         transforms={})
                 restored_dict = ptc.restore(
                     os.path.join(path, "state"), args=args)
-    except Exception as e:
+    except Exception as e:  # any orbax failure -> CLI-catchable ValueError
         raise ValueError(f"cannot restore orbax checkpoint {path!r}: "
                          f"{e}") from e
     if convert:
@@ -546,7 +554,7 @@ def _read(path: str) -> dict:
                          f"{e.strerror or e}") from e
     try:
         payload = serialization.msgpack_restore(blob)
-    except Exception as e:
+    except Exception as e:  # any decode failure -> CLI-catchable ValueError
         raise ValueError(f"corrupt checkpoint file {path!r}: {e}") from e
     if not isinstance(payload, dict) \
             or payload.get("format_version") != _FORMAT_VERSION:
@@ -574,7 +582,8 @@ def _load_checkpoint_inner(path: str, state: TrainState,
     if os.path.isdir(path):
         return _load_orbax(path, state, restore_optimizer)
     payload = _read(path)
-    template = jax.device_get(gather_replicated(state))
+    with runtime.sanctioned_host_transfer():  # restore-template snapshot
+        template = jax.device_get(gather_replicated(state))
     template_sd = serialization.to_state_dict(template)
     if not restore_optimizer:  # test path passes optimizer=None (ref :232)
         payload["state"]["opt_state"] = template_sd.get("opt_state", {})

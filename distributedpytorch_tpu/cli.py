@@ -279,7 +279,8 @@ def _run_eval_pass(engine: Engine, state, loader, epoch: int
                 m = engine.eval_step(state, images, labels, valid)
                 totals = m if totals is None else jax.tree_util.tree_map(
                     jnp.add, totals, m)
-        totals = jax.device_get(totals)
+        with runtime.sanctioned_host_transfer():  # per-epoch sync point
+            totals = jax.device_get(totals)
     loss = float(totals["loss_numer"] / max(totals["loss_denom"], 1e-9))
     acc = float(totals["correct"] / max(totals["valid"], 1.0))
     return loss, acc
@@ -316,7 +317,8 @@ def _run_train_pass(engine: Engine, state, loader, epoch: int, key
                 tel.span("train_dispatch", epoch=epoch, steps=nb_iters):
             state, metrics = engine.train_epoch(
                 state, loader.images, loader.labels, idx, valid, key)
-            metrics = jax.device_get(metrics)
+            with runtime.sanctioned_host_transfer():  # per-epoch sync
+                metrics = jax.device_get(metrics)
         if runtime.is_main():
             _progress_logs(epoch, metrics["loss"])
         epoch_loss = float(np.mean(metrics["loss"]))
@@ -354,9 +356,10 @@ def _run_train_pass(engine: Engine, state, loader, epoch: int, key
         valid_hist.append(metrics["valid"])
         if runtime.is_main():
             print(f"\r{epoch:03d} {i / nb_iters * 100:.0f}%", end="\r")
-    losses, corrects, valids = jax.device_get(
-        jnp.stack([jnp.stack(loss_hist), jnp.stack(correct_hist),
-                   jnp.stack(valid_hist)]))
+    with runtime.sanctioned_host_transfer():  # ONE sync per epoch
+        losses, corrects, valids = jax.device_get(
+            jnp.stack([jnp.stack(loss_hist), jnp.stack(correct_hist),
+                       jnp.stack(valid_hist)]))
     losses = np.asarray(losses)
     if runtime.is_main():
         _progress_logs(epoch, losses)
@@ -399,7 +402,8 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
                 state, train_loader.images, train_loader.labels, idx_tr,
                 valid_tr, valid_loader.images, valid_loader.labels, idx_va,
                 valid_va, keys)
-            out = jax.device_get(out)
+            with runtime.sanctioned_host_transfer():  # per-chunk sync
+                out = jax.device_get(out)
         end = utils.monotonic()
 
         per_epoch_s = (end - chunk_start) / len(chunk)
@@ -863,6 +867,13 @@ def run_test(cfg: Config) -> dict:
 
 def main(argv=None) -> int:
     cfg = config_from_argv(argv)
+    if cfg.action == "lint":
+        # Static analysis (analysis/ graftlint): pure AST work, no JAX
+        # backend, no training banners.  Exit 0 = clean, 1 = findings.
+        from .analysis.core import run_cli as lint_cli
+
+        return lint_cli(json_output=cfg.lint_json,
+                        paths=cfg.lint_paths or None)
     if cfg.action == "telemetry":
         # Offline aggregation of RSL_PATH/telemetry/rank*.jsonl — no
         # training banners, no JAX backend touched.

@@ -171,6 +171,10 @@ class Config:
     # 'model' mesh axis when --model-parallel >= 2, replicated experts
     # otherwise.  Exclusive with --tensor-parallel/--pipeline-parallel.
     moe_experts: int = 0
+    # 'lint' subcommand (analysis/ graftlint): machine-readable output
+    # and an optional focused path list (empty = the full repo scope).
+    lint_json: bool = False
+    lint_paths: tuple = ()
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -356,6 +360,15 @@ def build_parser() -> argparse.ArgumentParser:
     p_rep.add_argument("--rsl_path", type=str, default=RSL_PATH,
                        help=f"run directory holding telemetry/ "
                             f"(default: {RSL_PATH})")
+
+    # Static analysis (analysis/ graftlint) — no JAX backend touched.
+    p_lint = sub.add_parser(
+        "lint", help="run the graftlint static analysis pass "
+                     "(exit 0 = clean; see scripts/graftlint.py)")
+    p_lint.add_argument("paths", nargs="*",
+                        help="files/dirs to lint (default: repo scope)")
+    p_lint.add_argument("--json", action="store_true",
+                        help="machine-readable findings output")
     return parser
 
 
@@ -363,6 +376,9 @@ def config_from_argv(argv=None) -> Config:
     args = build_parser().parse_args(argv)
     if args.action == "telemetry":
         return Config(action="telemetry", rsl_path=args.rsl_path)
+    if args.action == "lint":
+        return Config(action="lint", lint_json=args.json,
+                      lint_paths=tuple(args.paths))
     return Config(
         action=args.action,
         data_path=args.dataPath,

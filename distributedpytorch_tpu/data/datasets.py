@@ -18,7 +18,7 @@ minus iteration (pipeline.py) and transforms (augment.py, on device):
 from __future__ import annotations
 
 import logging
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict
 
 import numpy as np

@@ -159,15 +159,37 @@ class ShardedLoader:
             for r in self.local_ranks
         ]
         self.batches_per_epoch = self.samplers[0].batches_per_epoch
-        # Prefetch-queue observability state (ADVICE #4): exists from
-        # construction so tests/bench can always read it; None until the
-        # first prefetching iteration, and thereafter it reflects ONLY
-        # the most recent ``epoch()`` generator (two interleaved
-        # iterations of the same loader clobber each other's view —
-        # don't do that; each epoch() call rebinds it).  Synchronous
-        # path: a deque of device batches; threaded path: the list of
-        # bounded per-producer queues.
-        self._queue = None
+        # Prefetch-queue observability state (ADVICE #4), keyed PER
+        # EPOCH GENERATOR: ``_queues[epoch]`` is that epoch() call's
+        # live lookahead structure (synchronous path: a deque of device
+        # batches; threaded path: the list of bounded per-producer
+        # queues), so two interleaved iterations no longer clobber each
+        # other's view (tests/test_pipeline.py interleaved regression).
+        # ``_queue`` stays as the most-recently-started epoch's
+        # structure for existing consumers; ``queue_for(epoch)`` is the
+        # keyed accessor.  Entries persist after exhaustion (tests read
+        # them post-epoch), bounded to the newest few.
+        self._queues: "collections.OrderedDict[int, object]" = \
+            collections.OrderedDict()
+
+    _QUEUE_HISTORY = 8  # retained per-epoch entries (newest kept)
+
+    def _register_queue(self, epoch: int, queue) -> None:
+        self._queues.pop(epoch, None)
+        self._queues[epoch] = queue
+        while len(self._queues) > self._QUEUE_HISTORY:
+            self._queues.popitem(last=False)
+
+    @property
+    def _queue(self):
+        """Most-recently-started epoch's lookahead structure (None
+        before the first prefetching iteration)."""
+        return next(reversed(self._queues.values())) \
+            if self._queues else None
+
+    def queue_for(self, epoch: int):
+        """The lookahead structure of a specific epoch() generator."""
+        return self._queues.get(epoch)
 
     def __len__(self) -> int:
         return self.batches_per_epoch
@@ -237,11 +259,12 @@ class ShardedLoader:
                 wait.add(time.perf_counter() - t0)
                 batches.add(1)
                 yield arrays
-        # Instance attribute (not a local) so tests/bench can assert the
+        # Registered (not just a local) so tests/bench can assert the
         # overlap actually happens: in steady state the queue holds the
         # next batch(es) — already device_put, H2D in flight — while the
         # consumer computes on the previous one.
-        queue = self._queue = collections.deque()
+        queue = collections.deque()
+        self._register_queue(epoch, queue)
         if not tel.enabled:
             try:
                 while len(queue) < self.prefetch:
@@ -312,7 +335,7 @@ class ShardedLoader:
         queues = [queue_mod.Queue(maxsize=depth) for _ in range(nthreads)]
         # Tests/bench introspection parity with the sync path: expose the
         # bounded queues as this epoch's lookahead structure.
-        self._queue = queues
+        self._register_queue(epoch, queues)
 
         def _put(q, item) -> None:
             # Bounded put that aborts promptly once the consumer is gone.

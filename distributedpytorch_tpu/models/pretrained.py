@@ -327,7 +327,7 @@ def load_pretrained(model_name: str, path: Optional[str],
 
     try:
         obj = torch.load(path, map_location="cpu", weights_only=True)
-    except Exception as e:
+    except Exception as e:  # any torch.load failure -> CLI ValueError
         raise ValueError(f"cannot load pretrained weights {path!r}: {e}") \
             from e
     if not isinstance(obj, dict):

@@ -20,8 +20,10 @@ ref classif.py:63,153,176, so every node's GPU-0 writes logs/checkpoints).
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
+import threading
 from typing import Optional, Sequence
 
 import jax
@@ -248,7 +250,9 @@ def configure_compilation_cache(cache_dir: Optional[str]) -> None:
 
             monitoring.register_event_listener(_on_monitoring_event)
             _cache_listener_installed = True
-        except Exception:
+        except (ImportError, AttributeError):
+            # jax without jax.monitoring: the compile/cache_hit gauge is
+            # simply unavailable; caching itself still works
             pass
 
 
@@ -257,7 +261,7 @@ def reset_compilation_cache() -> None:
     must not keep writing into a possibly-deleted run directory."""
     try:
         jax.config.update("jax_compilation_cache_dir", None)
-    except Exception:
+    except Exception:  # jax without the option: nothing to detach
         pass
     _reset_cache_state()
 
@@ -268,7 +272,53 @@ def _reset_cache_state() -> None:
 
         _cc.reset_cache()
     except Exception:
+        # private-API best effort: a jax that moved/renamed it keeps the
+        # old cache object alive, which is safe (stale dir, not wrong
+        # results)
         pass
+
+
+_sanction_local = threading.local()
+
+
+def host_transfer_sanctioned() -> bool:
+    """True while the CURRENT THREAD is inside a
+    ``sanctioned_host_transfer()`` block — read by the transfer-guard
+    sanitizer's patched sync primitives (analysis/transfer_guard.py)."""
+    return getattr(_sanction_local, "depth", 0) > 0
+
+
+@contextlib.contextmanager
+def sanctioned_host_transfer():
+    """Context marking a device->host transfer as a sanctioned sync point.
+
+    The training loop's contract is per-EPOCH syncing: the only blocking
+    ``device_get``s are the epoch-end metric fetches and the checkpoint
+    snapshot.  Those sites wrap themselves in this context; the
+    transfer-guard sanitizer (analysis/transfer_guard.py) then runs a
+    smoke epoch with device->host transfers *disallowed* globally, so
+    any OTHER transfer — a per-step ``.item()``, a stray ``float()`` on
+    a device value, the reference's own bug class — fails the smoke
+    instead of silently serializing the hot path.
+
+    Two layers compose here: a thread-local sanction marker the
+    sanitizer's patched primitives consult (effective on every backend,
+    including CPU where jax's native guard sees no "transfer" at all),
+    and jax's own ``transfer_guard_device_to_host('allow')`` scope so
+    the native guard agrees on TPU/GPU.  Outside the sanitizer this is
+    free: the marker is a thread-local increment and the native scope
+    re-allows what the default config already allows.
+    """
+    _sanction_local.depth = getattr(_sanction_local, "depth", 0) + 1
+    guard = getattr(jax, "transfer_guard_device_to_host", None)
+    try:
+        if guard is None:  # very old jax without transfer guards
+            yield
+        else:
+            with guard("allow"):
+                yield
+    finally:
+        _sanction_local.depth -= 1
 
 
 def device_memory_limit() -> Optional[int]:
@@ -283,6 +333,8 @@ def device_memory_limit() -> Optional[int]:
         try:
             stats = d.memory_stats()
         except Exception:
+            # backend-specific call: CPU/virtual devices raise various
+            # types; "unknown" is the documented answer either way
             return None
         if not stats or "bytes_limit" not in stats:
             return None

@@ -309,7 +309,7 @@ def configure(rsl_path: str, enabled: bool, rank: Optional[int] = None
             import jax
 
             rank = jax.process_index()
-        except Exception:
+        except Exception:  # no jax / backend not initialized: rank 0
             rank = 0
     _active = Telemetry(enabled=enabled, rsl_path=rsl_path, rank=rank)
     return _active
@@ -359,29 +359,43 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     point_events: List[Dict[str, Any]] = []
     rank_epoch: Dict[int, List[float]] = {}
     ranks = set()
+    skipped = 0
     for ev in events:
-        rank = int(ev.get("rank", 0))
-        ranks.add(rank)
-        kind, name = ev.get("kind"), ev.get("name")
-        if kind == "span":
-            s = spans.setdefault(name, {"count": 0, "total_s": 0.0,
-                                        "max_s": 0.0})
-            dur = float(ev.get("dur_s", 0.0))
-            s["count"] += 1
-            s["total_s"] += dur
-            s["max_s"] = max(s["max_s"], dur)
-            if name == "epoch":
-                rank_epoch.setdefault(rank, []).append(dur)
-        elif kind == "counter":
-            counters[name] = counters.get(name, 0.0) \
-                + float(ev.get("value", 0.0))
-        elif kind == "gauge":
-            if ev.get("value") is not None:  # null = recorded-unavailable
-                gauges.setdefault(name, {})[rank] = float(ev["value"])
-        elif kind == "histogram":
-            histograms.setdefault(name, []).append(ev)
-        elif kind == "event":
-            point_events.append(ev)
+        # A rank file can be torn mid-write or hand-edited: an event
+        # with a missing name or a non-numeric value must degrade to a
+        # skipped line, never crash the whole report.
+        try:
+            rank = int(ev.get("rank", 0))
+            kind, name = ev.get("kind"), ev.get("name")
+            if not isinstance(name, str):
+                skipped += 1
+                continue
+            if kind == "span":
+                dur = float(ev.get("dur_s", 0.0))
+                s = spans.setdefault(name, {"count": 0, "total_s": 0.0,
+                                            "max_s": 0.0})
+                s["count"] += 1
+                s["total_s"] += dur
+                s["max_s"] = max(s["max_s"], dur)
+                if name == "epoch":
+                    rank_epoch.setdefault(rank, []).append(dur)
+            elif kind == "counter":
+                counters[name] = counters.get(name, 0.0) \
+                    + float(ev.get("value", 0.0))
+            elif kind == "gauge":
+                if ev.get("value") is not None:  # null = unavailable
+                    gauges.setdefault(name, {})[rank] = float(ev["value"])
+            elif kind == "histogram":
+                histograms.setdefault(name, []).append(ev)
+            elif kind == "event":
+                point_events.append(ev)
+            else:
+                skipped += 1
+                continue
+            ranks.add(rank)
+        except (TypeError, ValueError):
+            skipped += 1
+            continue
     for s in spans.values():
         s["mean_s"] = s["total_s"] / max(s["count"], 1)
 
@@ -394,6 +408,7 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
 
     return {
         "ranks": sorted(ranks),
+        "skipped_events": skipped,
         "spans": spans,
         "counters": counters,
         "gauges": {name: {"latest_per_rank": per,
@@ -412,6 +427,9 @@ def render_report(agg: Dict[str, Any]) -> str:
     lines = []
     lines.append(f"telemetry report — {len(agg['ranks'])} rank(s): "
                  f"{agg['ranks']}")
+    if agg.get("skipped_events"):
+        lines.append(f"({agg['skipped_events']} malformed event(s) "
+                     f"skipped)")
 
     spans = agg["spans"]
     if spans:

@@ -31,7 +31,7 @@ Design choices with reference citations:
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import flax.struct
 import jax
@@ -123,6 +123,9 @@ class Engine:
                 self.model, params, variables.get("batch_stats", {}),
                 batch=8, input_size=self.input_size)
         except Exception:
+            # the analytic FLOPs count is optional (MFU gauge +
+            # _pregather sizing only): any abstract-tracing failure for
+            # an exotic model disables those, never the training run
             self._flops_per_sample = None
         return TrainState(
             step=jnp.zeros((), jnp.int32),

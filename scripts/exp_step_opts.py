@@ -12,7 +12,6 @@ Each runs the same resident cnn/b64 epoch scan, steady-state timed.
 
 from __future__ import annotations
 
-import functools
 import os
 import sys
 import time

@@ -4,10 +4,17 @@
 # model code — precisely the check that round 3 skipped when it shipped
 # a red multichip gate.
 #
-#   1. canary tests (~4.5 min on this single-core host): the components a
+#   1. static analysis: graftlint (the framework rule catalog — see
+#      README "Static analysis & sanitizers"; suppressions need a
+#      rationale) and ruff (generic baseline, [tool.ruff] in
+#      pyproject.toml; leg skips with a notice when ruff is absent)
+#   2. canary tests (~4.5 min on this single-core host): the components a
 #      sharding/engine change can break — pipeline schedule + numerics,
 #      sharded==big-batch equivalence, engine mechanics, driver entry
-#   2. the driver's own gate: __graft_entry__.dryrun_multichip(8)
+#   3. transfer-guard smoke: one CPU streaming epoch with device->host
+#      syncs disallowed outside the sanctioned per-epoch points — the
+#      runtime sanitizer for the paper's per-batch .item() bug class
+#   4. the driver's own gate: __graft_entry__.dryrun_multichip(8)
 #      (clean env, exactly as the driver runs it)
 #
 # Tier map:
@@ -18,6 +25,21 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "== gate: graftlint static analysis =="
+python scripts/graftlint.py
+
+echo "== gate: ruff (generic lint baseline) =="
+if command -v ruff >/dev/null 2>&1; then
+    ruff check distributedpytorch_tpu tests scripts main.py bench.py \
+        __graft_entry__.py
+elif python -c "import ruff" >/dev/null 2>&1; then
+    python -m ruff check distributedpytorch_tpu tests scripts main.py \
+        bench.py __graft_entry__.py
+else
+    echo "ruff not installed — leg skipped ([tool.ruff] in pyproject"
+    echo "defines the contract; install ruff to enforce locally)"
+fi
+
 echo "== gate: canary tests =="
 python -m pytest tests/test_pipeline.py tests/test_distributed.py \
     tests/test_graft_entry.py tests/test_engine.py -q -x -m "not slow"
@@ -27,6 +49,9 @@ python scripts/check_bench.py
 
 echo "== gate: overlap regression (telemetry) =="
 env -u XLA_FLAGS -u JAX_PLATFORMS python scripts/overlap_gate.py
+
+echo "== gate: transfer-guard smoke (runtime sanitizer) =="
+env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/graftlint.py --smoke
 
 echo "== gate: dryrun_multichip(8) =="
 env -u XLA_FLAGS -u JAX_PLATFORMS python -c \

@@ -1,0 +1,54 @@
+#!/usr/bin/env python3
+"""graftlint — JAX/TPU-aware static analysis for this repo.
+
+Static pass (default): the eight framework rules in
+distributedpytorch_tpu/analysis/rules.py over the package, entry
+points, bench harness and scripts.  Exit 0 = clean, 1 = findings.
+
+    python scripts/graftlint.py            # human output
+    python scripts/graftlint.py --json     # machine-readable
+    python scripts/graftlint.py FILE...    # focused run
+    python main.py lint                    # equivalent in-CLI form
+
+Runtime sanitizer:
+
+    python scripts/graftlint.py --smoke    # 1-epoch CPU train under
+                                           # jax.transfer_guard; fails
+                                           # on silent device->host
+                                           # transfers
+
+Suppressions: ``# graftlint: disable=<rule> -- <rationale>`` (rationale
+required).  See README "Static analysis & sanitizers".
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: the repo scope)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings output")
+    p.add_argument("--smoke", action="store_true",
+                   help="run the transfer-guard runtime smoke instead "
+                        "of the static pass (forces JAX_PLATFORMS=cpu)")
+    args = p.parse_args()
+    if args.smoke:
+        from distributedpytorch_tpu.analysis.transfer_guard import \
+            main as smoke_main
+        return smoke_main()
+    from distributedpytorch_tpu.analysis.core import run_cli
+
+    return run_cli(json_output=args.json, paths=args.paths or None,
+                   root=_REPO_ROOT)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

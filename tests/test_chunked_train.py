@@ -1,6 +1,5 @@
 """--epochs-per-dispatch: fused-epoch training equals per-epoch training."""
 
-import numpy as np
 import pytest
 
 from distributedpytorch_tpu.cli import run_train

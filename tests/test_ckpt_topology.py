@@ -34,7 +34,6 @@ import json
 import os
 import signal
 import sys
-import time
 
 import numpy as np
 import pytest

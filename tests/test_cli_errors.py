@@ -3,10 +3,8 @@ traceback — the reference's error style (ref classif.py:119-120,130-131,
 utils.py:102-103).
 """
 
-import pytest
-
 from distributedpytorch_tpu.cli import main
-from distributedpytorch_tpu.config import Config, config_from_argv
+from distributedpytorch_tpu.config import config_from_argv
 
 
 def _argv(tmp_path, *extra):

@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 from distributedpytorch_tpu.ops import conv as conv_mod
-from distributedpytorch_tpu.ops.conv import Conv3x3, conv3x3_dw, conv3x3_same
+from distributedpytorch_tpu.ops.conv import conv3x3_dw, conv3x3_same
 
 
 def _ref_conv(x, w):

@@ -6,7 +6,6 @@ import struct
 
 import jax
 import numpy as np
-import pytest
 
 from distributedpytorch_tpu import runtime
 from distributedpytorch_tpu.data import augment, datasets, io, pipeline

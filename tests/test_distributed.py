@@ -8,10 +8,8 @@ is exactly DDP's allreduce-mean.
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributedpytorch_tpu import runtime
 from distributedpytorch_tpu.models import get_model

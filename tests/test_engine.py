@@ -5,11 +5,9 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 import pytest
 
 from distributedpytorch_tpu import checkpoint as ckpt
-from distributedpytorch_tpu import runtime
 from distributedpytorch_tpu.models import get_model
 from distributedpytorch_tpu.ops.losses import get_loss_fn
 from distributedpytorch_tpu.train.engine import Engine, make_optimizer

@@ -5,7 +5,6 @@ by the total denominator once (engine._train_step_accum).  ABSENT in the
 reference (SURVEY §2 parallelism checklist: no accumulation, no AMP)."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

@@ -1,0 +1,110 @@
+"""ShardedLoader prefetch-queue observability is keyed PER EPOCH
+GENERATOR (ISSUE 3 satellite): two interleaved epoch() iterations must
+expose distinct lookahead structures via queue_for(), instead of the
+pre-fix behavior where self._queue reflected only the most recent
+epoch() call and interleaved iterations clobbered each other's view.
+"""
+
+import numpy as np
+
+from distributedpytorch_tpu import runtime, telemetry
+from distributedpytorch_tpu.data.datasets import Split
+from distributedpytorch_tpu.data.io import make_synthetic
+from distributedpytorch_tpu.data.pipeline import ShardedLoader
+
+
+def _loader(prefetch=2, producer_threads=0):
+    tr_x, tr_y, _, _ = make_synthetic(num_train=64, num_test=8,
+                                      image_size=28, channels=1, seed=0)
+    mesh = runtime.make_mesh()
+    return ShardedLoader(Split(tr_x, tr_y), mesh, batch_per_replica=2,
+                         shuffle=False, seed=0, prefetch=prefetch,
+                         producer_threads=producer_threads)
+
+
+def test_queue_none_before_first_iteration():
+    loader = _loader()
+    assert loader._queue is None
+    assert loader.queue_for(0) is None
+
+
+def test_interleaved_epochs_keep_distinct_queues():
+    loader = _loader(prefetch=2)
+    it0 = loader.epoch(0)
+    it1 = loader.epoch(1)
+    a0 = next(it0)           # starts epoch 0's generator + queue
+    b0 = next(it1)           # starts epoch 1's generator + queue
+    q0, q1 = loader.queue_for(0), loader.queue_for(1)
+    assert q0 is not None and q1 is not None
+    assert q0 is not q1      # pre-fix: the second call clobbered this
+    # _queue (compat handle) tracks the most recently STARTED epoch
+    assert loader._queue is q1
+
+    # draining one epoch leaves the other's queue untouched and usable
+    rest0 = list(it0)
+    assert loader.queue_for(0) is q0
+    assert loader.queue_for(1) is q1 and len(q1) > 0
+    rest1 = list(it1)
+
+    n = len(loader)
+    assert 1 + len(rest0) == n and 1 + len(rest1) == n
+    # unshuffled loader: both epochs saw identical batch streams
+    np.testing.assert_array_equal(np.asarray(a0[0]), np.asarray(b0[0]))
+
+
+def test_interleaved_epochs_threaded_keyed():
+    loader = _loader(prefetch=2, producer_threads=2)
+    it0 = loader.epoch(0)
+    it1 = loader.epoch(1)
+    next(it0)
+    next(it1)
+    q0, q1 = loader.queue_for(0), loader.queue_for(1)
+    assert isinstance(q0, list) and isinstance(q1, list)
+    assert q0 is not q1
+    it0.close()              # clean producer shutdown mid-epoch
+    n1 = 1 + sum(1 for _ in it1)
+    assert n1 == len(loader)
+
+
+def test_rerunning_same_epoch_rebinds_its_key():
+    loader = _loader(prefetch=2)
+    list(loader.epoch(0))
+    first = loader.queue_for(0)
+    list(loader.epoch(0))
+    assert loader.queue_for(0) is not first
+
+
+def test_queue_history_bounded():
+    loader = _loader(prefetch=2)
+    for e in range(loader._QUEUE_HISTORY + 3):
+        list(loader.epoch(e))
+    assert len(loader._queues) == loader._QUEUE_HISTORY
+    assert loader.queue_for(0) is None  # oldest pruned
+
+
+def test_interleaved_wait_accounting_still_sums(tmp_path,
+                                                monkeypatch):
+    """data/wait_s stays a process-global cumulative counter; the keyed
+    queues fix the INTROSPECTION clobbering.  Interleaving two epochs
+    must still count every batch exactly once."""
+    loader = _loader(prefetch=2)
+    tel = telemetry.configure(str(tmp_path), enabled=True, rank=0)
+    try:
+        it0, it1 = loader.epoch(0), loader.epoch(1)
+        done0 = done1 = False
+        n = 0
+        while not (done0 and done1):
+            for it, attr in ((it0, "done0"), (it1, "done1")):
+                try:
+                    next(it)
+                    n += 1
+                except StopIteration:
+                    if attr == "done0":
+                        done0 = True
+                    else:
+                        done1 = True
+        assert n == 2 * len(loader)
+        assert tel.counter("data/batches").value == n
+    finally:
+        tel.close()
+        telemetry.configure(str(tmp_path), enabled=False)

@@ -26,7 +26,7 @@ import sys
 import numpy as np
 import pytest
 
-from tests._subproc import await_all, child_env, launch_logged
+from tests._subproc import await_all, child_env
 
 pytestmark = pytest.mark.slow
 

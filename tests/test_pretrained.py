@@ -9,8 +9,6 @@ weights load correctly whenever the user supplies them
 (ref utils.py:38-105 use_pretrained).
 """
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -41,7 +41,6 @@ def test_env_only_rendezvous_two_processes(tmp_path):
     Uses the shared _subproc scaffolding: log FILES (a full PIPE would
     block a chatty child mid-collective and deadlock the world) and
     await_all's shared deadline + straggler kill."""
-    import os
     import subprocess
     import sys
 

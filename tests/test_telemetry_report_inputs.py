@@ -1,0 +1,135 @@
+"""Telemetry report robustness (ISSUE 3 satellite): the aggregator must
+degrade gracefully on the inputs real runs produce — an empty run dir, a
+rank file missing (killed host), a torn last line (killed mid-write),
+and hand-mangled event fields — through both CLI entries.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distributedpytorch_tpu import telemetry
+from distributedpytorch_tpu.telemetry import (aggregate, load_events,
+                                              render_report, report)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_rank(tmp_path, rank, events, torn_tail=None):
+    d = tmp_path / "telemetry"
+    d.mkdir(exist_ok=True)
+    lines = [json.dumps(e) for e in events]
+    if torn_tail is not None:
+        lines.append(torn_tail)
+    (d / f"rank{rank}.jsonl").write_text("\n".join(lines) + "\n")
+    return str(d)
+
+
+def _span(rank, name, dur, **attrs):
+    ev = {"kind": "span", "name": name, "dur_s": dur, "parent": None,
+          "ts": 1.0, "rank": rank}
+    if attrs:
+        ev["attrs"] = attrs
+    return ev
+
+
+def test_missing_directory_is_value_error(tmp_path):
+    with pytest.raises(ValueError, match="no telemetry directory"):
+        load_events(str(tmp_path / "telemetry"))
+
+
+def test_empty_directory_is_value_error(tmp_path):
+    (tmp_path / "telemetry").mkdir()
+    with pytest.raises(ValueError, match="no telemetry events"):
+        load_events(str(tmp_path / "telemetry"))
+
+
+def test_partial_one_rank_missing(tmp_path):
+    """2-of-3 ranks present (one host died before flushing): the report
+    still renders, scoped to the ranks that wrote files."""
+    _write_rank(tmp_path, 0, [_span(0, "epoch", 1.0, epoch=0),
+                              _span(0, "train_pass", 0.7, epoch=0)])
+    d = _write_rank(tmp_path, 2, [_span(2, "epoch", 3.0, epoch=0)])
+    agg = aggregate(load_events(d))
+    assert agg["ranks"] == [0, 2]
+    text = render_report(agg)
+    assert "2 rank(s)" in text
+    assert "slowest" in text  # straggler view over the present ranks
+    assert agg["epoch_s_per_rank"][2] == pytest.approx(3.0)
+
+
+def test_truncated_last_line_skipped(tmp_path):
+    """A run killed mid-write leaves a torn final line; it must be
+    skipped, not crash the whole report."""
+    d = _write_rank(tmp_path, 0,
+                    [_span(0, "epoch", 1.0),
+                     {"kind": "counter", "name": "data/batches",
+                      "value": 8, "ts": 1.0, "rank": 0}],
+                    torn_tail='{"kind": "span", "name": "tr')
+    events = load_events(d)
+    assert len(events) == 2
+    agg = aggregate(events)
+    assert agg["counters"]["data/batches"] == 8
+
+
+def test_malformed_events_skipped_not_fatal(tmp_path):
+    """Events with wrong-typed fields (hand-edited files, version skew)
+    are counted as skipped, and the rest still aggregate."""
+    d = _write_rank(tmp_path, 0, [
+        _span(0, "epoch", 1.0),
+        {"kind": "counter", "name": "data/batches", "value": "NaNope",
+         "ts": 1.0, "rank": 0},                       # bad value type
+        {"kind": "gauge", "name": "throughput/mfu", "value": [1],
+         "ts": 1.0, "rank": 0},                       # bad value type
+        {"kind": "span", "name": 7, "dur_s": 1.0,
+         "ts": 1.0, "rank": 0},                       # bad name type
+        {"kind": "mystery", "name": "x", "ts": 1.0, "rank": 0},
+        {"kind": "counter", "name": "data/batches", "value": 3,
+         "ts": 1.0, "rank": "zero"},                  # bad rank type
+    ])
+    agg = aggregate(load_events(d))
+    assert agg["skipped_events"] == 5
+    # none of the counter rows were well-formed, so the counter is absent
+    assert "data/batches" not in agg["counters"]
+    assert agg["spans"]["epoch"]["count"] == 1
+    assert "malformed event(s) skipped" in render_report(agg)
+
+
+def test_report_entry_points_empty_dir(tmp_path):
+    """Both CLI entries surface the empty-input error as exit 1 with a
+    message, not a traceback."""
+    from distributedpytorch_tpu.cli import main
+
+    assert main(["telemetry", "--rsl_path", str(tmp_path)]) == 1
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "telemetry_report.py"),
+         "--rsl_path", str(tmp_path)],
+        capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "error:" in proc.stderr
+    assert "Traceback" not in proc.stderr
+
+
+def test_report_function_on_partial_run(tmp_path):
+    _write_rank(tmp_path, 0, [_span(0, "epoch", 1.0)])
+    text = report(str(tmp_path))
+    assert "telemetry report" in text
+
+
+def test_close_is_idempotent_after_partial_configure(tmp_path):
+    """configure() then immediate close() leaves no file when nothing
+    was emitted — and a second close is a no-op."""
+    tel = telemetry.configure(str(tmp_path), enabled=True, rank=5)
+    tel.event("run_start")
+    tel.close()
+    tel.close()
+    path = tmp_path / "telemetry" / "rank5.jsonl"
+    lines = path.read_text().strip().splitlines()
+    assert len(lines) == 1
+    # restore the module singleton for other tests
+    telemetry.configure(str(tmp_path), enabled=False)

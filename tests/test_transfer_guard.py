@@ -1,0 +1,67 @@
+"""Transfer-guard sanitizer (analysis/transfer_guard.py): the smoke
+passes on the real training loop and FAILS when a per-step host sync —
+the paper's own bug class (ref classif.py:61-62) — is injected.
+
+Also pins the fact that motivates the sanitizer's patched-primitive
+layer: on the CPU backend jax's native transfer guard records no
+device->host transfer at all (a CPU buffer is already host memory), so
+without the shim a CPU smoke would be vacuous.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributedpytorch_tpu import runtime
+from distributedpytorch_tpu.analysis import transfer_guard as tg
+
+
+def test_native_guard_is_vacuous_on_cpu():
+    """The design premise: if this ever starts raising, the patched
+    primitives could be retired in favor of the native guard alone."""
+    x = jnp.ones(4) + 1
+    with jax.transfer_guard_device_to_host("disallow_explicit"):
+        jax.device_get(x)  # does NOT raise on the CPU backend
+
+
+def test_patched_primitives_block_unsanctioned_syncs():
+    x = jnp.ones(4) + 1
+    with tg._patched_sync_primitives():
+        with pytest.raises(tg.HostTransferViolation):
+            jax.device_get(x)
+        with pytest.raises(tg.HostTransferViolation):
+            float(x[0])
+        with pytest.raises(tg.HostTransferViolation):
+            x[0].item()
+        # the sanctioned context re-allows, and nests
+        with runtime.sanctioned_host_transfer():
+            assert float(jax.device_get(x)[0]) == 2.0
+    # patches restored: unguarded sync works again
+    assert float(x[0]) == 2.0
+
+
+def test_patched_primitives_restore_on_error():
+    orig = jax.device_get
+    with pytest.raises(RuntimeError, match="boom"):
+        with tg._patched_sync_primitives():
+            raise RuntimeError("boom")
+    assert jax.device_get is orig
+
+
+def test_smoke_passes_on_clean_loop(tmp_path):
+    assert tg.run_smoke(rsl_path=str(tmp_path)) is True
+
+
+def test_smoke_fails_on_injected_per_step_device_get(tmp_path):
+    """Acceptance criterion: a deliberate per-step jax.device_get in
+    the train loop turns the smoke red."""
+    assert tg.run_smoke(rsl_path=str(tmp_path),
+                        inject_host_sync=True) is False
+
+
+def test_injection_does_not_leak(tmp_path):
+    from distributedpytorch_tpu import cli
+
+    before = cli._build_engine
+    tg.run_smoke(rsl_path=str(tmp_path), inject_host_sync=True)
+    assert cli._build_engine is before

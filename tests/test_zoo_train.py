@@ -11,7 +11,6 @@ reduced input sizes where the topology allows (adaptive pooling makes the
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
