@@ -891,6 +891,102 @@ class BareExcept(Rule):
                         f"why broad is correct")
 
 
+class RetryWithoutBackoff(Rule):
+    """A loop that catches an exception and goes around again with no
+    delay is a hot-spin retry: against a struggling filesystem or a
+    coordinator that is still coming up it hammers the failing resource
+    thousands of times per second instead of giving it room to recover.
+    Every retry loop must either sleep between attempts (ideally
+    exponential backoff with jitter — ``faults.RetryPolicy``) or bound
+    each attempt with a ``timeout=`` so the wait IS the pacing (the
+    bounded-queue put/get pattern in data/pipeline.py)."""
+
+    name = "retry-without-backoff"
+    description = ("loop retries a caught exception with no sleep/"
+                   "backoff and no timeout-bounded attempt")
+
+    # A call whose name looks like pacing: time.sleep, asyncio.sleep, a
+    # policy's .call/.retry wrapper, or anything *backoff*-named.
+    PACING_SEGS = ("sleep", "backoff")
+    PACING_WRAPPERS = ("retry", "call")
+
+    def _paces(self, node: ast.AST) -> bool:
+        for call in walk_calls(node):
+            cn = last_seg(call_name(call)).lower()
+            if any(seg in cn for seg in self.PACING_SEGS):
+                return True
+            if cn in self.PACING_WRAPPERS \
+                    and "retry" in call_name(call).lower():
+                return True
+        return False
+
+    def _bounded(self, try_node: ast.Try) -> bool:
+        """An attempt whose blocking call carries ``timeout=`` paces
+        itself — the wait between retries is the timeout."""
+        return any(kwarg(call, "timeout") is not None
+                   for stmt in try_node.body
+                   for call in walk_calls(stmt))
+
+    # Iterator-exhaustion signals are loop control flow, not failures
+    # being retried (the pipeline's queue-drain loops catch these).
+    CONTROL_EXCS = ("StopIteration", "StopAsyncIteration",
+                    "GeneratorExit")
+
+    def _is_retry_loop(self, loop) -> bool:
+        """Retry loops re-attempt the SAME operation: ``while`` loops,
+        and ``for`` loops counting attempts over ``range()``.  A ``for``
+        over a collection is per-item processing — skipping a bad item
+        and moving on is not a retry."""
+        if isinstance(loop, ast.While):
+            return True
+        it = loop.iter
+        if not (isinstance(it, ast.Call)
+                and last_seg(call_name(it)) == "range"):
+            return False
+        hints = {dotted(loop.target).lower()} | {
+            n.lower() for a in it.args for n in names_in(a)}
+        return any(h in name for name in hints
+                   for h in ("attempt", "retr", "tries"))
+
+    def _control_flow_only(self, handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        elts = t.elts if isinstance(t, ast.Tuple) else [t] if t else []
+        return bool(elts) and all(
+            last_seg(dotted(e)) in self.CONTROL_EXCS for e in elts)
+
+    def _swallows(self, handler: ast.ExceptHandler) -> bool:
+        """True when the handler just eats the error and lets the loop
+        spin: only pass/continue/bare-expression (logging) statements.
+        raise/return/break escape; an assignment captures the error for
+        structured handling elsewhere."""
+        return all(isinstance(stmt, (ast.Pass, ast.Continue, ast.Expr))
+                   for stmt in handler.body)
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            for loop in ast.walk(mod.tree):
+                if not isinstance(loop, (ast.For, ast.While)) \
+                        or not self._is_retry_loop(loop):
+                    continue
+                if self._paces(loop):
+                    continue
+                for node in ast.walk(loop):
+                    if not isinstance(node, ast.Try):
+                        continue
+                    if self._bounded(node):
+                        continue
+                    for handler in node.handlers:
+                        if self._swallows(handler) \
+                                and not self._control_flow_only(handler):
+                            yield self.finding(
+                                mod, handler.lineno,
+                                "retry loop with no backoff: the "
+                                "handler swallows the error and spins "
+                                "— sleep between attempts (see "
+                                "faults.RetryPolicy) or bound the "
+                                "attempt with timeout=")
+
+
 RULES = (
     HostSyncInStepLoop(),
     TraceImpurity(),
@@ -900,6 +996,7 @@ RULES = (
     ThreadSharedState(),
     ConfigDrift(),
     BareExcept(),
+    RetryWithoutBackoff(),
 )
 
 RULES_BY_NAME = {r.name: r for r in RULES}

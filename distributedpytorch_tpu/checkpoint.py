@@ -38,25 +38,34 @@ Two formats behind one API (``--ckpt-format``):
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
 import queue as queue_mod
+import re
 import shutil
 import threading
-from typing import Callable, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 import jax
 import numpy as np
 from flax import serialization
 from jax.sharding import NamedSharding, PartitionSpec
 
-from . import runtime, telemetry
+from . import faults, runtime, telemetry
 from .models import vit_pipeline
 from .train.engine import TrainState
 
 _FORMAT_VERSION = 1
 _ORBAX_META = "meta.json"
+_LINEAGE = "ckpt-lineage.json"
+
+# Restore-path transient classification: FileNotFoundError (an OSError)
+# must NOT be retried — a missing file never appears by waiting — and
+# the read sites use this narrowed tuple instead of faults.TRANSIENT.
+_READ_TRANSIENT = (PermissionError, InterruptedError, TimeoutError,
+                   faults.InjectedIOError)
 
 
 def gather_replicated(state: TrainState) -> TrainState:
@@ -107,6 +116,203 @@ def best_model_path(rsl_path: str, dataset: str, model_name: str) -> str:
     return os.path.join(rsl_path, f"bestmodel-{dataset}-{model_name}.ckpt")
 
 
+# -- checkpoint lineage: checksums, verify-on-load, fallback (ISSUE 5) --
+#
+# Every write records (file, epoch, checksum, bytes) into a rolling
+# ledger next to the checkpoints (RSL_PATH/ckpt-lineage.json); loads
+# verify the content against the recorded checksum BEFORE trusting it,
+# and the resume path can walk the lineage back to the newest VALID
+# snapshot when the head is torn or corrupt (loud log + telemetry event,
+# never silent).  msgpack files get a full-content sha256; orbax
+# directories get a structural checksum (sorted relpath:size listing of
+# the payload files) in their meta.json — cheap at any scale and exactly
+# what detects the realistic corruption (torn/partial/missing shard
+# files), though not in-place bit flips of equal length.
+
+_lineage_lock = threading.Lock()
+
+
+def lineage_path(dirname: str) -> str:
+    return os.path.join(dirname, _LINEAGE)
+
+
+def _lineage_load(dirname: str) -> dict:
+    try:
+        with open(lineage_path(dirname)) as f:
+            doc = json.load(f)
+        if isinstance(doc, dict) and isinstance(doc.get("records"), list):
+            return doc
+    except (OSError, ValueError):
+        # absent or torn ledger: lineage degrades to "nothing recorded"
+        # (loads then skip verification) rather than blocking a resume
+        pass
+    return {"records": []}
+
+
+def _lineage_record(path: str, epoch: int, checksum: str,
+                    nbytes: int) -> None:
+    """Record one written checkpoint in the ledger (atomic rewrite);
+    entries whose file has since been rotated away are pruned."""
+    path = os.path.abspath(path)
+    dirname, name = os.path.split(path)
+    with _lineage_lock:
+        doc = _lineage_load(dirname)
+        records = [r for r in doc["records"]
+                   if r.get("file") != name
+                   and os.path.exists(os.path.join(dirname,
+                                                   str(r.get("file"))))]
+        records.append({"file": name, "epoch": int(epoch),
+                        "sha256": checksum, "bytes": int(nbytes)})
+        tmp = lineage_path(dirname) + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"records": records}, f, indent=1)
+            os.replace(tmp, lineage_path(dirname))
+        except OSError as e:
+            # the ledger is recovery metadata, not training state: losing
+            # an entry weakens verification, it must not fail the save
+            logging.warning(f"cannot update checkpoint lineage ledger "
+                            f"{lineage_path(dirname)!r}: {e}")
+
+
+def _lineage_forget(path: str) -> None:
+    """Drop a rotated-away checkpoint's ledger entry (atomic rewrite,
+    same best-effort contract as ``_lineage_record``) so the ledger
+    always mirrors what is actually on disk."""
+    dirname, name = os.path.split(os.path.abspath(path))
+    with _lineage_lock:
+        doc = _lineage_load(dirname)
+        records = [r for r in doc["records"] if r.get("file") != name]
+        if len(records) == len(doc["records"]):
+            return
+        tmp = lineage_path(dirname) + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"records": records}, f, indent=1)
+            os.replace(tmp, lineage_path(dirname))
+        except OSError as e:
+            # stale entry, not wrong results: the file is gone, so the
+            # fallback walk never offers it anyway
+            logging.warning(f"cannot update checkpoint lineage ledger "
+                            f"{lineage_path(dirname)!r}: {e}")
+
+
+def _lineage_entry(path: str) -> Optional[dict]:
+    dirname, name = os.path.split(os.path.abspath(path))
+    with _lineage_lock:
+        doc = _lineage_load(dirname)
+    for r in doc["records"]:
+        if r.get("file") == name:
+            return r
+    return None
+
+
+def _orbax_checksum(root: str) -> str:
+    """Structural checksum of an orbax checkpoint directory: sha256 over
+    the sorted relpath:size listing of every payload file (meta.json
+    excluded, so the value can live inside meta.json itself)."""
+    entries = []
+    for dirpath, _, fnames in os.walk(root):
+        for fn in fnames:
+            if dirpath == root and fn == _ORBAX_META:
+                continue
+            full = os.path.join(dirpath, fn)
+            entries.append(f"{os.path.relpath(full, root)}:"
+                           f"{os.path.getsize(full)}")
+    h = hashlib.sha256()
+    for line in sorted(entries):
+        h.update(line.encode())
+        h.update(b"\n")
+    return h.hexdigest()
+
+
+def verify_checkpoint(path: str) -> Optional[str]:
+    """None when the checkpoint matches its recorded checksum (or none
+    was recorded — pre-lineage files stay loadable); otherwise a one-line
+    reason string.  Never raises."""
+    if os.path.isdir(path):
+        try:
+            with open(os.path.join(path, _ORBAX_META)) as f:
+                meta = json.load(f)
+        except (OSError, ValueError) as e:
+            return f"unreadable {_ORBAX_META} ({e})"
+        want = meta.get("checksum") if isinstance(meta, dict) else None
+        if want is None:
+            return None
+        got = _orbax_checksum(os.path.abspath(path))
+        if got != want:
+            return (f"content checksum mismatch (recorded "
+                    f"{want[:12]}…, found {got[:12]}…)")
+        return None
+    rec = _lineage_entry(path)
+    if rec is None:
+        return None
+    try:
+        with open(path, "rb") as f:
+            got = hashlib.sha256(f.read()).hexdigest()
+    except OSError as e:
+        return f"cannot read ({e.strerror or e})"
+    if got != rec.get("sha256"):
+        return (f"content checksum mismatch (lineage records "
+                f"{str(rec.get('sha256'))[:12]}…, found {got[:12]}…)")
+    return None
+
+
+def list_checkpoints(rsl_path: str, dataset: str,
+                     model_name: str) -> List[str]:
+    """Rolling checkpoint paths for (dataset, model) under ``rsl_path``,
+    newest epoch first — the fallback candidates."""
+    pat = re.compile(rf"checkpoint-{re.escape(dataset)}-"
+                     rf"{re.escape(model_name)}-(\d+)\.ckpt")
+    found = []
+    try:
+        names = os.listdir(rsl_path)
+    except OSError:
+        return []
+    for name in names:
+        m = pat.fullmatch(name)
+        if m:
+            found.append((int(m.group(1)),
+                          os.path.join(rsl_path, name)))
+    return [p for _, p in sorted(found, reverse=True)]
+
+
+def load_checkpoint_with_fallback(path: str, state: TrainState,
+                                  rsl_path: str, dataset: str,
+                                  model_name: str,
+                                  restore_optimizer: bool = True
+                                  ) -> Tuple[TrainState, int, float]:
+    """``load_checkpoint`` with lineage recovery: when the requested
+    checkpoint is torn or corrupt, fall back — LOUDLY (error log +
+    ``ckpt_fallback`` telemetry event per skipped snapshot, never
+    silent) — to the newest valid earlier rolling snapshot."""
+    tel = telemetry.get()
+    seen = {os.path.abspath(path)}
+    candidates = [path]
+    for cand in list_checkpoints(rsl_path, dataset, model_name):
+        if os.path.abspath(cand) not in seen:
+            seen.add(os.path.abspath(cand))
+            candidates.append(cand)
+    errors = []
+    for cand in candidates:
+        reason = verify_checkpoint(cand)
+        if reason is None:
+            try:
+                return load_checkpoint(cand, state, restore_optimizer)
+            except ValueError as e:
+                reason = str(e)
+        errors.append(f"{cand}: {reason}")
+        logging.error(f"CHECKPOINT REJECTED {cand!r}: {reason}"
+                      + ("; falling back to an earlier snapshot"
+                         if cand != candidates[-1] else ""))
+        tel.event("ckpt_fallback", skipped=os.path.basename(cand),
+                  reason=reason)
+    detail = "; ".join(errors)
+    raise ValueError(
+        f"no valid checkpoint to resume from under {rsl_path!r} "
+        f"(tried {len(candidates)}: {detail})")
+
+
 def _msgpack_payload(model_name: str, state: TrainState, epoch: int,
                      best_valid_loss: float) -> dict:
     """The host-side snapshot: everything the file needs, with no live
@@ -125,13 +331,25 @@ def _msgpack_payload(model_name: str, state: TrainState, epoch: int,
 def _write_msgpack(path: str, payload: dict) -> None:
     """Serialize + atomic tmp->rename write.  Pure host/file work — safe
     to run on a background thread (AsyncSaver); a crash at any point
-    leaves the previous file at ``path`` intact."""
+    leaves the previous file at ``path`` intact.  Transient write errors
+    are retried under the process retry policy; the full-content sha256
+    is recorded in the lineage ledger for verify-on-load."""
     blob = serialization.msgpack_serialize(payload)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(blob)
-    os.replace(tmp, path)
+
+    def _attempt():
+        faults.fire("ckpt.save", path=path)
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+
+    faults.retry(_attempt, "ckpt.save")
+    # Post-rename hook: the torn/preempt chaos kinds act on the FINAL
+    # file, exactly like a failure landing after the atomic swap.
+    faults.fire("ckpt.finalize", path=path)
+    _lineage_record(path, payload["epoch"],
+                    hashlib.sha256(blob).hexdigest(), len(blob))
     logging.info(f"epoch:{payload['epoch']:04d}: model saved to {path}")
 
 
@@ -172,9 +390,24 @@ class AsyncSaver:
     write cannot pass silently.  Drivers must ``wait()`` (or ``close()``)
     before process exit — and before telemetry close, so the background
     spans land in the JSONL.
+
+    ``on_error='degrade'`` (what the training driver passes, ISSUE 5):
+    instead of re-raising, the first background failure is logged +
+    emitted as a ``ckpt_async_degraded`` telemetry event and the saver
+    switches to SYNCHRONOUS execution of every later job on the driver
+    thread — the run keeps checkpointing (a persistent failure then
+    surfaces from the synchronous write itself) rather than dying at
+    close over an already-finished epoch.  The default stays 'raise':
+    library callers keep the must-not-pass-silently contract.
     """
 
-    def __init__(self):
+    def __init__(self, on_error: str = "raise"):
+        if on_error not in ("raise", "degrade"):
+            raise ValueError(
+                f"AsyncSaver on_error must be 'raise' or 'degrade', "
+                f"got {on_error!r}")
+        self.on_error = on_error
+        self.degraded = False
         self._queue = queue_mod.Queue()
         # graftlint: guarded-by=_queue.join -- single writer thread sets
         # it before task_done(); the driver reads it from submit()/wait()
@@ -198,9 +431,17 @@ class AsyncSaver:
                 self._queue.task_done()
 
     def _raise_pending(self) -> None:
-        if self._exc is not None:
-            exc, self._exc = self._exc, None
+        if self._exc is None:
+            return
+        exc, self._exc = self._exc, None
+        if self.on_error != "degrade":
             raise exc
+        if not self.degraded:
+            self.degraded = True
+            logging.error(
+                f"async checkpoint writer FAILED ({exc!r}); degrading "
+                "to synchronous saves for the rest of the run")
+            telemetry.get().event("ckpt_async_degraded", error=str(exc))
 
     @property
     def in_flight(self) -> bool:
@@ -209,6 +450,9 @@ class AsyncSaver:
 
     def submit(self, fn: Callable[[], None]) -> None:
         self._raise_pending()
+        if self.degraded:
+            fn()  # synchronous fallback: ordering preserved, run survives
+            return
         if self._thread is None:
             self._thread = threading.Thread(target=self._worker,
                                             name="dpt-ckpt-writer",
@@ -287,6 +531,7 @@ def save_checkpoint_async(saver: AsyncSaver, path: str, model_name: str,
                 shutil.rmtree(tmp)
             ckptr = ocp.StandardCheckpointer()
             state_sd = serialization.to_state_dict(state)
+            faults.fire("ckpt.save", path=path)
             ckptr.save(os.path.join(tmp, "state"), state_sd)
             meta = _orbax_meta(model_name, epoch, best_valid_loss,
                                state_sd)
@@ -355,6 +600,7 @@ def _save_orbax(path: str, model_name: str, state: TrainState,
     runtime.barrier()  # nobody saves into .tmp until the cleanup is done
     ckptr = ocp.StandardCheckpointer()
     state_sd = serialization.to_state_dict(state)
+    faults.fire("ckpt.save", path=path)
     ckptr.save(os.path.join(tmp, "state"), state_sd)
     ckptr.wait_until_finished()
     runtime.barrier()  # every host's shards are on disk before the swap
@@ -384,12 +630,26 @@ def _orbax_meta(model_name: str, epoch: int, best_valid_loss: float,
 def _orbax_finalize(path: str, tmp: str, meta: dict) -> None:
     """meta.json write + the atomic tmp->dir swap (single writer).  The
     COMPLETE checkpoint exists under .tmp before this runs, so a crash
-    before/inside it leaves the previous checkpoint at ``path`` intact."""
-    with open(os.path.join(tmp, _ORBAX_META), "w") as f:
-        json.dump(meta, f)
-    if os.path.exists(path):
-        shutil.rmtree(path)
-    os.replace(tmp, path)
+    before/inside it leaves the previous checkpoint at ``path`` intact.
+    The structural content checksum (see ``_orbax_checksum``) goes into
+    meta.json here — the payload is final once the shard writes landed —
+    and the swap is retried under the process retry policy."""
+    meta = dict(meta, checksum=_orbax_checksum(tmp))
+
+    def _attempt():
+        with open(os.path.join(tmp, _ORBAX_META), "w") as f:
+            json.dump(meta, f)
+        if os.path.exists(path):
+            shutil.rmtree(path)
+        os.replace(tmp, path)
+
+    faults.retry(_attempt, "ckpt.finalize")
+    # Post-swap hook: torn/preempt chaos kinds act on the FINAL
+    # directory, like a failure landing right after the swap.
+    faults.fire("ckpt.finalize", path=path)
+    total = sum(os.path.getsize(os.path.join(dp, fn))
+                for dp, _, fns in os.walk(path) for fn in fns)
+    _lineage_record(path, meta["epoch"], meta["checksum"], total)
     logging.info(f"epoch:{meta['epoch']:04d}: model saved to {path}")
 
 
@@ -425,26 +685,63 @@ def _check_layouts_convertible(path: str, src: str, dst: str,
             "--moe-experts / --pipeline-parallel configuration")
 
 
+def _read_orbax_meta(path: str) -> dict:
+    """Read + validate ``meta.json`` in a checkpoint directory.  Failure
+    is a ONE-LINE actionable ValueError naming the path and the expected
+    producer (ISSUE 5 satellite) — not a raw traceback."""
+    meta_path = os.path.join(path, _ORBAX_META)
+    if not os.path.exists(meta_path):
+        raise ValueError(
+            f"{path}: missing {_ORBAX_META} — not an orbax checkpoint "
+            f"directory; expected one produced by this framework's "
+            f"--ckpt-format orbax save (or pass a .ckpt msgpack file)")
+
+    def _attempt():
+        faults.fire("ckpt.restore", path=path)
+        with open(meta_path) as f:
+            return f.read()
+
+    try:
+        raw = faults.retry(_attempt, "ckpt.restore",
+                           transient=_READ_TRANSIENT)
+    except OSError as e:
+        raise ValueError(
+            f"{path}: cannot read {_ORBAX_META} ({e.strerror or e}) — "
+            f"expected the metadata written by this framework's "
+            f"--ckpt-format orbax save") from e
+    try:
+        meta = json.loads(raw)
+        if not isinstance(meta, dict):
+            raise ValueError("not a JSON object")
+    except ValueError as e:
+        raise ValueError(
+            f"{path}: garbage {_ORBAX_META} ({e}) — expected the JSON "
+            f"metadata written by this framework's --ckpt-format orbax "
+            f"save; the directory is corrupt or foreign, restore from "
+            f"an earlier snapshot") from e
+    return meta
+
+
 def _load_orbax(path: str, state: TrainState, restore_optimizer: bool
                 ) -> Tuple[TrainState, int, float]:
+    path = os.path.abspath(path)
+    # meta.json first (plain JSON, no orbax needed): a missing/corrupt
+    # directory surfaces its actionable error even where orbax isn't
+    # installed; only an actual restore requires the dependency.
+    meta = _read_orbax_meta(path)
+    if meta.get("format_version") != _FORMAT_VERSION:
+        raise ValueError(f"{path}: unsupported checkpoint format "
+                         f"{meta.get('format_version')!r}")
+    # Verify-on-load (ISSUE 5): never trust a torn/corrupt snapshot.
+    reason = verify_checkpoint(path)
+    if reason is not None:
+        raise ValueError(f"{path}: corrupt checkpoint — {reason}")
     # Loading auto-detects orbax by directory-ness, without --ckpt-format
     # orbax ever being passed — so the availability check must happen
     # here, surfacing the CLI-catchable ValueError rather than a raw
     # ImportError traceback.
     require_orbax()
     import orbax.checkpoint as ocp
-
-    path = os.path.abspath(path)
-    meta_path = os.path.join(path, _ORBAX_META)
-    try:
-        with open(meta_path) as f:
-            meta = json.load(f)
-    except (OSError, ValueError) as e:
-        raise ValueError(f"{path}: not a valid orbax checkpoint "
-                         f"({e})") from e
-    if meta.get("format_version") != _FORMAT_VERSION:
-        raise ValueError(f"{path}: unsupported checkpoint format "
-                         f"{meta.get('format_version')!r}")
     # Shapes/dtypes only — no device_get: the template may hold sharded
     # (multi-host: non-addressable) arrays, and copying params+opt_state
     # to host just to read .shape would be waste anyway.  Restore target
@@ -545,13 +842,29 @@ def _load_orbax(path: str, state: TrainState, restore_optimizer: bool
 def _read(path: str) -> dict:
     """Read + validate a checkpoint; all failure modes surface as ValueError
     so the CLI can log-and-exit (ref classif.py:119-120 style) instead of
-    tracebacking on a missing or corrupt file."""
-    try:
+    tracebacking on a missing or corrupt file.  Transient read errors are
+    retried; the content is verified against the lineage ledger's
+    recorded sha256 (when one exists) BEFORE it is trusted."""
+
+    def _attempt() -> bytes:
+        faults.fire("ckpt.restore", path=path)
         with open(path, "rb") as f:
-            blob = f.read()
+            return f.read()
+
+    try:
+        blob = faults.retry(_attempt, "ckpt.restore",
+                            transient=_READ_TRANSIENT)
     except OSError as e:
         raise ValueError(f"cannot read checkpoint file {path!r}: "
                          f"{e.strerror or e}") from e
+    rec = _lineage_entry(path)
+    if rec is not None:
+        got = hashlib.sha256(blob).hexdigest()
+        if got != rec.get("sha256"):
+            raise ValueError(
+                f"{path}: corrupt checkpoint — content checksum mismatch "
+                f"(lineage records {str(rec.get('sha256'))[:12]}…, found "
+                f"{got[:12]}…)")
     try:
         payload = serialization.msgpack_restore(blob)
     except Exception as e:  # any decode failure -> CLI-catchable ValueError
@@ -619,21 +932,28 @@ def get_checkpoint_model_name(path: str) -> str:
     if os.path.isdir(path):
         # meta.json is plain JSON — sniffing needs no orbax; only the
         # actual restore (_load_orbax) requires the dependency.
-        meta_path = os.path.join(path, _ORBAX_META)
-        try:
-            with open(meta_path) as f:
-                return str(json.load(f)["model_name"])
-        except (OSError, ValueError, KeyError) as e:
-            raise ValueError(f"{path}: not a valid orbax checkpoint "
-                             f"({e})") from e
+        meta = _read_orbax_meta(os.path.abspath(path))
+        if "model_name" not in meta:
+            raise ValueError(
+                f"{path}: {_ORBAX_META} has no model_name — expected "
+                f"the metadata written by this framework's --ckpt-format "
+                f"orbax save")
+        return str(meta["model_name"])
     return str(_read(path)["model_name"])
 
 
 def rotate_checkpoint(rsl_path: str, dataset: str, model_name: str,
-                      epoch: int) -> None:
-    """Delete epoch-1's rolling file/dir (ref classif.py:182-184, fixed)."""
-    prev = checkpoint_path(rsl_path, dataset, model_name, epoch - 1)
+                      epoch: int, keep: int = 1) -> None:
+    """Delete the rolling file/dir ``keep`` epochs back, retaining the
+    newest ``keep`` snapshots (ref classif.py:182-184, fixed; keep=1 is
+    the original delete-previous behavior, keep>1 is the keep-K lineage
+    the corruption-fallback resume walks)."""
+    prev = checkpoint_path(rsl_path, dataset, model_name,
+                           epoch - max(1, keep))
     if os.path.isdir(prev):
         shutil.rmtree(prev)
     elif os.path.exists(prev):
         os.remove(prev)
+    else:
+        return
+    _lineage_forget(prev)

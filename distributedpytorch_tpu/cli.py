@@ -20,7 +20,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import checkpoint as ckpt
-from . import parallel, runtime, telemetry, utils
+from . import faults, parallel, runtime, telemetry, utils
 from .config import Config, config_from_argv
 from .data import augment  # noqa: F401  (re-exported for drivers/tests)
 from .data.datasets import Dataset, Split, load_dataset
@@ -137,10 +137,11 @@ def _rotate_ckpt(cfg: Config, saver, model_name: str, epoch: int) -> None:
         return
     if saver is not None:
         saver.submit(lambda: ckpt.rotate_checkpoint(
-            cfg.rsl_path, cfg.dataset, model_name, epoch))
+            cfg.rsl_path, cfg.dataset, model_name, epoch,
+            keep=cfg.keep_ckpts))
     else:
         ckpt.rotate_checkpoint(cfg.rsl_path, cfg.dataset, model_name,
-                               epoch)
+                               epoch, keep=cfg.keep_ckpts)
 
 
 def _make_loader(cfg: Config, split: Split, mesh, shuffle: bool):
@@ -388,95 +389,97 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
                            min(epoch + cfg.epochs_per_dispatch,
                                cfg.nb_epochs)))
         chunk_start = utils.monotonic()
-        idx_tr, valid_tr = train_loader.epoch_plan_many(chunk)
-        idx_va, valid_va = valid_loader.epoch_plan_many(chunk)
-        keys = jnp.stack([utils.fold_key(root, e) for e in chunk])
-        # K fused epochs = ONE dispatch: the span (device_get included)
-        # is the real compute wall-clock for the whole chunk, annotated
-        # so --profile traces carry the same name.
-        with jax.profiler.StepTraceAnnotation("chunk_dispatch",
-                                              step_num=epoch), \
-                tel.span("chunk_dispatch", first_epoch=epoch,
-                         epochs=len(chunk)):
-            state, out = engine.train_epochs(
-                state, train_loader.images, train_loader.labels, idx_tr,
-                valid_tr, valid_loader.images, valid_loader.labels, idx_va,
-                valid_va, keys)
-            with runtime.sanctioned_host_transfer():  # per-chunk sync
-                out = jax.device_get(out)
-        end = utils.monotonic()
+        chunk_err = None
+        try:
+            idx_tr, valid_tr = train_loader.epoch_plan_many(chunk)
+            idx_va, valid_va = valid_loader.epoch_plan_many(chunk)
+            keys = jnp.stack([utils.fold_key(root, e) for e in chunk])
+            # K fused epochs = ONE dispatch: the span (device_get
+            # included) is the real compute wall-clock for the whole
+            # chunk, annotated so --profile traces carry the same name.
+            with jax.profiler.StepTraceAnnotation("chunk_dispatch",
+                                                  step_num=epoch), \
+                    tel.span("chunk_dispatch", first_epoch=epoch,
+                             epochs=len(chunk)):
+                state, out = engine.train_epochs(
+                    state, train_loader.images, train_loader.labels,
+                    idx_tr, valid_tr, valid_loader.images,
+                    valid_loader.labels, idx_va, valid_va, keys)
+                with runtime.sanctioned_host_transfer():  # per-chunk sync
+                    out = jax.device_get(out)
+            end = utils.monotonic()
 
-        per_epoch_s = (end - chunk_start) / len(chunk)
-        train_samples = len(train_loader) * train_loader.global_batch
-        sps_chip = train_samples / max(per_epoch_s, 1e-9) / world
-        if tel.enabled:
-            _record_throughput(tel, sps_chip, fps, peak, chunk[-1])
-        chunk_improved = False
-        for k, e in enumerate(chunk):
-            train_loss = float(np.mean(out["train_loss"][k]))
-            train_acc = float(out["train_correct"][k]
-                              / max(out["train_valid"][k], 1.0))
-            valid_loss = float(out["eval"]["loss_numer"][k]
-                               / max(out["eval"]["loss_denom"][k], 1e-9))
-            valid_acc = float(out["eval"]["correct"][k]
-                              / max(out["eval"]["valid"][k], 1.0))
-            improved = valid_loss < best_valid_loss
-            if runtime.is_main():
-                print(f"====================== epoch{e + 1:4d} "
-                      f"======================")
-                _progress_logs(e, out["train_loss"][k])
-                epoch_mins, epoch_secs = utils.get_duration(0, per_epoch_s)
-                mins, _ = utils.get_duration(start_time, end)
-                logging.info(
-                    f"{'*' if improved else ' '} Epoch: {e + 1:03}  "
-                    f"| Duration: {epoch_mins:03d}m {epoch_secs:02d}s  "
-                    f"| Overall duration: {mins / 60:.2f}h")
-                logging.info(f"  Train       | Loss: {train_loss:.5f}       "
-                             f"| Acc: {train_acc * 100:.2f}%")
-                logging.info(f"  Validation  | Loss: {valid_loss:.5f}       "
-                             f"| Acc: {valid_acc * 100:.2f}%")
-                logging.info(f"  Throughput  | {sps_chip:,.0f} "
-                             f"samples/s/chip ({world} chip"
-                             f"{'s' if world > 1 else ''})")
-            if improved:
-                best_valid_loss = valid_loss
-                chunk_improved = True
-            history.append({"epoch": e, "train_loss": train_loss,
-                            "train_acc": train_acc,
-                            "valid_loss": valid_loss,
-                            "valid_acc": valid_acc})
+            per_epoch_s = (end - chunk_start) / len(chunk)
+            train_samples = len(train_loader) * train_loader.global_batch
+            sps_chip = train_samples / max(per_epoch_s, 1e-9) / world
+            if tel.enabled:
+                _record_throughput(tel, sps_chip, fps, peak, chunk[-1])
+            chunk_improved = False
+            for k, e in enumerate(chunk):
+                train_loss = float(np.mean(out["train_loss"][k]))
+                train_acc = float(out["train_correct"][k]
+                                  / max(out["train_valid"][k], 1.0))
+                valid_loss = float(out["eval"]["loss_numer"][k]
+                                   / max(out["eval"]["loss_denom"][k],
+                                         1e-9))
+                valid_acc = float(out["eval"]["correct"][k]
+                                  / max(out["eval"]["valid"][k], 1.0))
+                improved = valid_loss < best_valid_loss
+                if runtime.is_main():
+                    print(f"====================== epoch{e + 1:4d} "
+                          f"======================")
+                    _progress_logs(e, out["train_loss"][k])
+                    epoch_mins, epoch_secs = utils.get_duration(
+                        0, per_epoch_s)
+                    mins, _ = utils.get_duration(start_time, end)
+                    logging.info(
+                        f"{'*' if improved else ' '} Epoch: {e + 1:03}  "
+                        f"| Duration: {epoch_mins:03d}m {epoch_secs:02d}s"
+                        f"  | Overall duration: {mins / 60:.2f}h")
+                    logging.info(f"  Train       | Loss: {train_loss:.5f}"
+                                 f"       | Acc: {train_acc * 100:.2f}%")
+                    logging.info(f"  Validation  | Loss: {valid_loss:.5f}"
+                                 f"       | Acc: {valid_acc * 100:.2f}%")
+                    logging.info(f"  Throughput  | {sps_chip:,.0f} "
+                                 f"samples/s/chip ({world} chip"
+                                 f"{'s' if world > 1 else ''})")
+                if improved:
+                    best_valid_loss = valid_loss
+                    chunk_improved = True
+                history.append({"epoch": e, "train_loss": train_loss,
+                                "train_acc": train_acc,
+                                "valid_loss": valid_loss,
+                                "valid_acc": valid_acc})
 
-        last = chunk[-1]
-        saveable = _saveable_state(cfg, state)
-        _rotate_ckpt(cfg, saver, model_name, last)
-        for prev in chunk[:-1]:  # rolling files from earlier chunks
-            _rotate_ckpt(cfg, saver, model_name, prev)
-        _save_ckpt(cfg,
-                   ckpt.checkpoint_path(cfg.rsl_path, cfg.dataset,
-                                        model_name, last),
-                   model_name, saveable, last, best_valid_loss, saver)
-        if chunk_improved:
-            # Only the chunk-final state exists on host, so the best
-            # file holds it (an approximation of the true best epoch
-            # inside the chunk) — but it is written whenever ANY epoch
-            # in the chunk improved, keeping the recorded
-            # best_valid_loss and the best-model file in sync.
+            last = chunk[-1]
+            saveable = _saveable_state(cfg, state)
+            _rotate_ckpt(cfg, saver, model_name, last)
+            for prev in chunk[:-1]:  # rolling files from earlier chunks
+                _rotate_ckpt(cfg, saver, model_name, prev)
             _save_ckpt(cfg,
-                       ckpt.best_model_path(cfg.rsl_path, cfg.dataset,
-                                            model_name),
+                       ckpt.checkpoint_path(cfg.rsl_path, cfg.dataset,
+                                            model_name, last),
                        model_name, saveable, last, best_valid_loss, saver)
-        epoch = last + 1
-        tel.flush()  # chunk boundary: buffered events hit the disk
-        # Agreed across hosts so everyone leaves at the same chunk
-        # boundary.  Granularity is the K-epoch chunk: one XLA dispatch
-        # cannot be interrupted (documented trade-off of
-        # --epochs-per-dispatch; size the grace window accordingly).
-        if runtime.any_process(shutdown.requested):
-            shutdown.requested = True
-            tel.event("preempt", after_epoch=last)
-            if runtime.is_main():
-                logging.info(f"preempted after epoch {last + 1}: "
-                             f"checkpoint written, resume with -f")
+            if chunk_improved:
+                # Only the chunk-final state exists on host, so the best
+                # file holds it (an approximation of the true best epoch
+                # inside the chunk) — but it is written whenever ANY
+                # epoch in the chunk improved, keeping the recorded
+                # best_valid_loss and the best-model file in sync.
+                _save_ckpt(cfg,
+                           ckpt.best_model_path(cfg.rsl_path, cfg.dataset,
+                                                model_name),
+                           model_name, saveable, last, best_valid_loss,
+                           saver)
+            epoch = last + 1
+        # Broad on purpose: ANY host-side failure (checkpoint I/O,
+        # injected fault) must reach the SAME health allgather on every
+        # rank — handling happens in _health_boundary.  Granularity is
+        # the K-epoch chunk: one XLA dispatch cannot be interrupted
+        # (documented trade-off of --epochs-per-dispatch).
+        except Exception as e:
+            chunk_err = e
+        if _health_boundary(tel, shutdown, chunk[-1], chunk_err):
             break
     return {"history": history, "best_valid_loss": best_valid_loss,
             "model_name": model_name, "state": state,
@@ -485,6 +488,10 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
 
 def run_train(cfg: Config) -> dict:
     """ref train() (classif.py:75-192), TPU-native."""
+    # Before distributed init: the runtime.init retry/fault site must be
+    # live for the initialize call itself.
+    faults.configure(cfg.fault_plan, cfg.fault_seed, cfg.retry_max_attempts,
+                     cfg.retry_base_delay, cfg.retry_timeout)
     runtime.initialize_distributed()
     utils.initialize_logging(cfg.rsl_path, cfg.log_file,
                              truncate=runtime.is_main())
@@ -522,7 +529,19 @@ def run_train(cfg: Config) -> dict:
     # Model name: resume reads it from the checkpoint (fixes SURVEY defect
     # #3 — ref classif.py:93 calls a misspelled helper and crashes).
     if cfg.checkpoint_file:
-        model_name = ckpt.get_checkpoint_model_name(cfg.checkpoint_file)
+        try:
+            model_name = ckpt.get_checkpoint_model_name(
+                cfg.checkpoint_file)
+        except ValueError as e:
+            # A torn/corrupt head must not kill the restart: the lineage
+            # fallback below recovers the STATE from an earlier snapshot;
+            # the model name then comes from --model (loudly, since a
+            # mismatched --model still fails at restore with a clear
+            # template error).
+            logging.warning(f"cannot read model name from "
+                            f"{cfg.checkpoint_file!r} ({e}); using "
+                            f"--model {cfg.model_name}")
+            model_name = cfg.model_name
     else:
         model_name = cfg.model_name
 
@@ -653,8 +672,13 @@ def run_train(cfg: Config) -> dict:
             # no transient fully-replicated copy of a state that may
             # only fit sharded (checkpoint.py leaf_target).
             state = _place_state(state, mesh, cfg)
-        state, start_epoch, best_valid_loss = ckpt.load_checkpoint(
-            cfg.checkpoint_file, state)
+        # Lineage-aware resume: a torn/corrupt head checkpoint falls back
+        # (loudly) to the newest snapshot that verifies, instead of
+        # killing the restart loop on the very file a crash mangled.
+        state, start_epoch, best_valid_loss = \
+            ckpt.load_checkpoint_with_fallback(
+                cfg.checkpoint_file, state, cfg.rsl_path, cfg.dataset,
+                model_name)
         state = _place_state(state, mesh, cfg)
     else:
         if cfg.use_pretrained:
@@ -676,7 +700,11 @@ def run_train(cfg: Config) -> dict:
         _aot_warmup(cfg, engine, state, train_loader, valid_loader, root,
                     start_epoch)
 
-    saver = ckpt.AsyncSaver() if cfg.ckpt_async else None
+    # Degrade mode: a background-writer failure downgrades the run to
+    # synchronous saves (loud log + ckpt_async_degraded event) instead of
+    # killing a healthy training loop at the next join.
+    saver = (ckpt.AsyncSaver(on_error="degrade")
+             if cfg.ckpt_async else None)
     start_time = utils.monotonic()
     shutdown = utils.GracefulShutdown()
     try:
@@ -705,6 +733,38 @@ def run_train(cfg: Config) -> dict:
             runtime.reset_compilation_cache()
 
 
+def _health_boundary(tel, shutdown, epoch: int, err) -> bool:
+    """Epoch/chunk-boundary failure agreement.  ONE allgather carries
+    both the fatal flag and the shutdown flag (runtime.agree_health), so
+    the collective schedule on healthy ranks is unchanged from the old
+    shutdown-only check.  A rank that failed host-side re-raises its own
+    error; its peers raise PeerFailureError — every rank exits together,
+    none hangs waiting in a later collective.  Returns True when the run
+    should stop cleanly (preemption)."""
+    tel.flush()  # boundary: buffered events hit the disk
+    any_failed, any_shutdown = runtime.agree_health(
+        err is not None, shutdown.requested)
+    if any_failed:
+        # Loud on EVERY rank: each process's JSONL records who noticed
+        # and why before the coordinated exit — never a silent death.
+        tel.event("peer_failure", epoch=epoch, local=err is not None,
+                  error=repr(err) if err is not None else None)
+        tel.flush()
+        if err is not None:
+            raise err
+        raise faults.PeerFailureError(
+            f"a peer process failed during epoch {epoch + 1}; exiting "
+            "with it (health agreement)")
+    if any_shutdown:
+        shutdown.requested = True
+        tel.event("preempt", after_epoch=epoch)
+        if runtime.is_main():
+            logging.info(f"preempted after epoch {epoch + 1}: "
+                         f"checkpoint written, resume with -f")
+        return True
+    return False
+
+
 def _run_train_epochs(cfg: Config, engine: Engine, state, train_loader,
                       valid_loader, model_name: str, root, start_epoch: int,
                       best_valid_loss: float, start_time: float, world: int,
@@ -719,77 +779,80 @@ def _run_train_epochs(cfg: Config, engine: Engine, state, train_loader,
                   f"======================")
         epoch_start = utils.monotonic()
 
-        # SURVEY §5 tracing equivalent: trace the first post-compile epoch.
-        tracing = cfg.profile and epoch == start_epoch + 1
-        if tracing:
-            jax.profiler.start_trace(f"{cfg.rsl_path}/trace")
+        epoch_err = None
+        try:
+            # SURVEY §5 tracing: trace the first post-compile epoch.
+            tracing = cfg.profile and epoch == start_epoch + 1
+            if tracing:
+                jax.profiler.start_trace(f"{cfg.rsl_path}/trace")
 
-        epoch_key = utils.fold_key(root, epoch)
-        with tel.span("epoch", epoch=epoch):
-            with tel.span("train_pass", epoch=epoch,
-                          steps=len(train_loader)):
-                state, train_loss, train_acc = _run_train_pass(
-                    engine, state, train_loader, epoch, epoch_key)
-            train_end = utils.monotonic()
-            valid_loss, valid_acc = _run_eval_pass(
-                engine, state, valid_loader, epoch)
+            epoch_key = utils.fold_key(root, epoch)
+            with tel.span("epoch", epoch=epoch):
+                with tel.span("train_pass", epoch=epoch,
+                              steps=len(train_loader)):
+                    state, train_loss, train_acc = _run_train_pass(
+                        engine, state, train_loader, epoch, epoch_key)
+                train_end = utils.monotonic()
+                valid_loss, valid_acc = _run_eval_pass(
+                    engine, state, valid_loader, epoch)
 
-        if tracing:
-            jax.profiler.stop_trace()
-            if runtime.is_main():
-                logging.info(f"profiler trace written to "
-                             f"{cfg.rsl_path}/trace")
+            if tracing:
+                jax.profiler.stop_trace()
+                if runtime.is_main():
+                    logging.info(f"profiler trace written to "
+                                 f"{cfg.rsl_path}/trace")
 
-        end = utils.monotonic()
-        epoch_mins, epoch_secs = utils.get_duration(epoch_start, end)
-        mins, _secs = utils.get_duration(start_time, end)
-        train_samples = len(train_loader) * train_loader.global_batch
-        sps_chip = train_samples / max(train_end - epoch_start, 1e-9) / world
-        if tel.enabled:
-            _record_throughput(tel, sps_chip, fps, peak, epoch)
+            end = utils.monotonic()
+            epoch_mins, epoch_secs = utils.get_duration(epoch_start, end)
+            mins, _secs = utils.get_duration(start_time, end)
+            train_samples = len(train_loader) * train_loader.global_batch
+            sps_chip = (train_samples
+                        / max(train_end - epoch_start, 1e-9) / world)
+            if tel.enabled:
+                _record_throughput(tel, sps_chip, fps, peak, epoch)
 
-        # Update best BEFORE any checkpoint write so the rolling file
-        # carries the post-epoch best; saving it first would make a resume
-        # from an improving epoch restore a stale best_valid_loss.
-        improved = valid_loss < best_valid_loss
-        if improved:
-            best_valid_loss = valid_loss
-        saveable = _saveable_state(cfg, state)
-        if runtime.is_main():  # ref classif.py:176-192
-            logging.info(
-                f"{'*' if improved else ' '} Epoch: {epoch + 1:03}  "
-                f"| Duration: {epoch_mins:03d}m {epoch_secs:02d}s  "
-                f"| Overall duration: {mins / 60:.2f}h")
-            logging.info(f"  Train       | Loss: {train_loss:.5f}       "
-                         f"| Acc: {train_acc * 100:.2f}%")
-            logging.info(f"  Validation  | Loss: {valid_loss:.5f}       "
-                         f"| Acc: {valid_acc * 100:.2f}%")
-            # North-star metric surfaced per epoch (BASELINE.md).
-            logging.info(f"  Throughput  | {sps_chip:,.0f} samples/s/chip "
-                         f"({world} chip{'s' if world > 1 else ''})")
-        _rotate_ckpt(cfg, saver, model_name, epoch)
-        _save_ckpt(cfg,
-                   ckpt.checkpoint_path(cfg.rsl_path, cfg.dataset,
-                                        model_name, epoch),
-                   model_name, saveable, epoch, best_valid_loss, saver)
-        if improved:
+            # Update best BEFORE any checkpoint write so the rolling file
+            # carries the post-epoch best; saving it first would make a
+            # resume from an improving epoch restore a stale
+            # best_valid_loss.
+            improved = valid_loss < best_valid_loss
+            if improved:
+                best_valid_loss = valid_loss
+            saveable = _saveable_state(cfg, state)
+            if runtime.is_main():  # ref classif.py:176-192
+                logging.info(
+                    f"{'*' if improved else ' '} Epoch: {epoch + 1:03}  "
+                    f"| Duration: {epoch_mins:03d}m {epoch_secs:02d}s  "
+                    f"| Overall duration: {mins / 60:.2f}h")
+                logging.info(f"  Train       | Loss: {train_loss:.5f}     "
+                             f"  | Acc: {train_acc * 100:.2f}%")
+                logging.info(f"  Validation  | Loss: {valid_loss:.5f}     "
+                             f"  | Acc: {valid_acc * 100:.2f}%")
+                # North-star metric surfaced per epoch (BASELINE.md).
+                logging.info(f"  Throughput  | {sps_chip:,.0f} "
+                             f"samples/s/chip "
+                             f"({world} chip{'s' if world > 1 else ''})")
+            _rotate_ckpt(cfg, saver, model_name, epoch)
             _save_ckpt(cfg,
-                       ckpt.best_model_path(cfg.rsl_path, cfg.dataset,
-                                            model_name),
+                       ckpt.checkpoint_path(cfg.rsl_path, cfg.dataset,
+                                            model_name, epoch),
                        model_name, saveable, epoch, best_valid_loss, saver)
-        history.append({"epoch": epoch, "train_loss": train_loss,
-                        "train_acc": train_acc, "valid_loss": valid_loss,
-                        "valid_acc": valid_acc})
-        tel.flush()  # epoch boundary: buffered events hit the disk
-        # Agreed across hosts (runtime.any_process) so every process
-        # leaves the loop at the SAME epoch — a lone host breaking early
-        # would deadlock the others in the next collective.
-        if runtime.any_process(shutdown.requested):
-            shutdown.requested = True
-            tel.event("preempt", after_epoch=epoch)
-            if runtime.is_main():
-                logging.info(f"preempted after epoch {epoch + 1}: "
-                             f"checkpoint written, resume with -f")
+            if improved:
+                _save_ckpt(cfg,
+                           ckpt.best_model_path(cfg.rsl_path, cfg.dataset,
+                                                model_name),
+                           model_name, saveable, epoch, best_valid_loss,
+                           saver)
+            history.append({"epoch": epoch, "train_loss": train_loss,
+                            "train_acc": train_acc,
+                            "valid_loss": valid_loss,
+                            "valid_acc": valid_acc})
+        # Broad on purpose: ANY host-side failure (data pipeline,
+        # checkpoint I/O, injected fault) must reach the SAME health
+        # allgather on every rank — handling happens in _health_boundary.
+        except Exception as e:
+            epoch_err = e
+        if _health_boundary(tel, shutdown, epoch, epoch_err):
             break
     # Final state is returned so callers (multi-process tests, notebooks)
     # can inspect the trained parameters without re-reading a checkpoint.
@@ -819,6 +882,8 @@ def run_test(cfg: Config) -> dict:
             f"seq_parallel={cfg.seq_parallel}, "
             f"attention={cfg.attention!r}, "
             f"pipeline_parallel={cfg.pipeline_parallel}")
+    faults.configure(cfg.fault_plan, cfg.fault_seed, cfg.retry_max_attempts,
+                     cfg.retry_base_delay, cfg.retry_timeout)
     runtime.initialize_distributed()
     utils.initialize_logging(cfg.rsl_path, cfg.log_file,
                              truncate=runtime.is_main())
@@ -891,6 +956,12 @@ def main(argv=None) -> int:
             run_test(cfg)
     except ValueError as e:  # ref style: log and exit (classif.py:119,130)
         logging.error(f"{e}, exiting...")
+        return 1
+    except (faults.FatalFaultError, faults.PeerFailureError) as e:
+        # Agreed-upon fatal exit: every rank takes this path together
+        # (see _health_boundary), so the nonzero status is coordinated
+        # rather than one rank dying and the rest hanging.
+        logging.error(f"fatal failure: {e}, exiting...")
         return 1
     print("========================= end ==========================")
     return 0

@@ -171,6 +171,24 @@ class Config:
     # 'model' mesh axis when --model-parallel >= 2, replicated experts
     # otherwise.  Exclusive with --tensor-parallel/--pipeline-parallel.
     moe_experts: int = 0
+    # Fault injection + retry policy (faults.py, ISSUE 5).  fault_plan is
+    # the DSL string "site:kind:after_n[:count]" (';'-separated) or the
+    # path of a JSON plan file; None (the default) installs NO plan and
+    # keeps every injection site zero-cost.  fault_seed feeds the plan
+    # and the deterministic retry-jitter stream.
+    fault_plan: Optional[str] = None
+    fault_seed: int = 0
+    # Retry policy for the transient-failure sites (dataset reads,
+    # checkpoint write/restore, distributed init): attempts per site,
+    # first backoff delay (doubles per attempt, jittered), and the
+    # per-site wall-clock deadline after which no new attempt starts.
+    retry_max_attempts: int = 3
+    retry_base_delay: float = 0.05
+    retry_timeout: float = 60.0
+    # Rolling-checkpoint lineage depth: how many per-epoch snapshots are
+    # retained (1 = the reference delete-previous behavior; >1 gives the
+    # corruption-fallback resume earlier snapshots to walk back to).
+    keep_ckpts: int = 1
     # 'lint' subcommand (analysis/ graftlint): machine-readable output
     # and an optional focused path list (empty = the full repo scope).
     lint_json: bool = False
@@ -243,6 +261,39 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                         "abstract batch shapes before epoch 0 (records "
                         "compile/warmup_s + compile/cache_hit telemetry "
                         "gauges)")
+    p.add_argument("--fault-plan", type=str, default=None,
+                   dest="faultPlan", metavar="PLAN",
+                   help="fault-injection plan: 'site:kind:after_n[:count]' "
+                        "(';'-separated, e.g. 'data.read:ioerror:2') or a "
+                        "JSON plan file; sites: data.read data.host_batch "
+                        "ckpt.save ckpt.finalize ckpt.restore runtime.init "
+                        "telemetry.write; kinds: ioerror fatal preempt "
+                        "torn (default: no faults, zero overhead)")
+    p.add_argument("--fault-seed", type=int, default=0, dest="faultSeed",
+                   metavar="S",
+                   help="seed for the fault plan + deterministic retry "
+                        "jitter (default 0)")
+    p.add_argument("--retry-max-attempts", type=int, default=3,
+                   dest="retryMaxAttempts", metavar="N",
+                   help="attempts per transient-failure site (dataset "
+                        "reads, checkpoint I/O, distributed init) before "
+                        "giving up (default 3)")
+    p.add_argument("--retry-base-delay", type=float, default=0.05,
+                   dest="retryBaseDelay", metavar="SEC",
+                   help="first retry backoff delay in seconds; doubles "
+                        "per attempt with deterministic jitter "
+                        "(default 0.05)")
+    p.add_argument("--retry-timeout", type=float, default=60.0,
+                   dest="retryTimeout", metavar="SEC",
+                   help="per-site wall-clock retry deadline: no new "
+                        "attempt starts after this many seconds "
+                        "(default 60)")
+    p.add_argument("--keep-ckpts", type=int, default=1, dest="keepCkpts",
+                   metavar="K",
+                   help="rolling-checkpoint lineage depth: retain the K "
+                        "newest per-epoch snapshots so corrupted heads "
+                        "can fall back to an older valid one (default 1 "
+                        "= delete-previous reference behavior)")
     p.add_argument("--feature-extract", action="store_true",
                    dest="featureExtract", default=FEATURE_EXTRACT,
                    help="freeze the backbone, train only the classifier "
@@ -399,6 +450,12 @@ def config_from_argv(argv=None) -> Config:
         prefetch=args.prefetch,
         producer_threads=args.producerThreads,
         ckpt_async=args.ckptAsync,
+        fault_plan=args.faultPlan,
+        fault_seed=args.faultSeed,
+        retry_max_attempts=args.retryMaxAttempts,
+        retry_base_delay=args.retryBaseDelay,
+        retry_timeout=args.retryTimeout,
+        keep_ckpts=args.keepCkpts,
         compilation_cache_dir=args.compilationCacheDir,
         no_compile_cache=args.noCompileCache,
         aot_warmup=args.aotWarmup,

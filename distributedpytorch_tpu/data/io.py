@@ -20,6 +20,8 @@ from typing import Tuple
 
 import numpy as np
 
+from .. import faults
+
 # torchvision layout: <root>/MNIST/raw/<file> (what the reference's
 # download=False load expects); we also accept the files directly in root.
 _MNIST_FILES = {
@@ -158,14 +160,30 @@ def load_raw(dataset: str, data_path: str, synthetic_fallback: bool = False):
     unless ``synthetic_fallback`` opts into the deterministic synthetic
     corpus (with a loud warning); accuracy numbers are then meaningless for
     the real dataset.
+
+    Transient read failures (a flaky network filesystem — or the
+    data.read fault site) are retried under the process retry policy;
+    FileNotFoundError is NOT retried (a missing corpus never becomes
+    present by waiting) and keeps its fallback semantics.
     """
-    try:
+
+    def _dispatch():
+        faults.fire("data.read")
         if dataset == "mnist":
             return load_mnist_like(data_path, "MNIST")
         if dataset == "fashion_mnist":
             return load_mnist_like(data_path, "FashionMNIST")
         if dataset == "cifar10":
             return load_cifar10(data_path)
+        return None
+
+    try:
+        out = faults.retry(
+            _dispatch, "data.read",
+            transient=(PermissionError, InterruptedError,
+                       faults.InjectedIOError, TimeoutError))
+        if out is not None:
+            return out
     except FileNotFoundError as e:
         if not synthetic_fallback:
             raise ValueError(

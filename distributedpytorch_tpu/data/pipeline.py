@@ -33,7 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .datasets import Split
 from .sampler import ShardedSampler
-from .. import telemetry
+from .. import faults, telemetry
 from ..runtime import DATA_AXIS
 
 
@@ -206,10 +206,29 @@ class ShardedLoader:
         valid = np.concatenate([v[step] for _, v in per_rank])
         return self.split.images[idx], self.split.labels[idx], valid
 
+    def _host_batch_fn(self):
+        """``self._host_batch``, or its fault-injecting/retrying twin
+        when the installed fault plan targets ``data.host_batch`` —
+        resolved ONCE per epoch, so without a plan the per-step hot
+        path carries no fault plumbing at all (acceptance criterion:
+        zero-cost when disabled)."""
+        if not faults.targets("data.host_batch"):
+            return self._host_batch
+
+        def faulty(per_rank, step):
+            def attempt():
+                faults.fire("data.host_batch")
+                return self._host_batch(per_rank, step)
+
+            return faults.retry(attempt, "data.host_batch")
+
+        return faulty
+
     def _host_batches(self, epoch: int):
         per_rank = [s.epoch_indices(epoch) for s in self.samplers]
+        host_batch = self._host_batch_fn()
         for step in range(self.batches_per_epoch):
-            yield self._host_batch(per_rank, step)
+            yield host_batch(per_rank, step)
 
     def _to_device(self, arrays) -> Tuple[jax.Array, ...]:
         if jax.process_count() == 1:
@@ -346,13 +365,14 @@ class ShardedLoader:
                 except queue_mod.Full:
                     continue
 
+        host_batch = self._host_batch_fn()
+
         def produce(t: int, q) -> None:
             try:
                 for step in range(t, self.batches_per_epoch, nthreads):
                     if stop.is_set():
                         return
-                    _put(q, self._to_device(self._host_batch(per_rank,
-                                                             step)))
+                    _put(q, self._to_device(host_batch(per_rank, step)))
             except BaseException as e:  # propagate to the consumer
                 _put(q, _ProducerFailure(e))
 

@@ -1,0 +1,409 @@
+"""L1: deterministic fault injection + retry/backoff policy.
+
+The happy path of this framework is well tested; this module makes the
+FAILURE paths testable (ISSUE 5).  Two halves:
+
+**Fault plans.**  A seeded, deterministic plan of faults to inject at
+named sites threaded through the runtime.  Sites currently wired:
+
+  data.read        data/io.py load_raw dispatch (dataset fetch)
+  data.host_batch  data/pipeline.py producer per-step host work
+  ckpt.save        checkpoint.py serialize+write (msgpack / orbax save)
+  ckpt.finalize    checkpoint.py post-rename/post-swap hook (receives the
+                   final path — the only site where kind=torn applies)
+  ckpt.restore     checkpoint.py read/restore
+  runtime.init     runtime.py jax.distributed.initialize
+  telemetry.write  telemetry.py JSONL writer
+
+Plan forms (``--fault-plan``):
+
+  DSL string   "site:kind:after_n[:count]" — ';'-separated for multiple
+               specs; fires on the (after_n+1)-th .. (after_n+count)-th
+               hit of the site (count defaults to 1).
+  JSON file    path to {"seed": S, "faults": [{"site": ..., "kind": ...,
+               "after_n": N, "count": C, "rank": R, "path_match": "sub"}
+               , ...]} — rank restricts a spec to one process,
+               path_match to fire() calls whose path contains the
+               substring.
+
+Kinds: ``ioerror`` (raise InjectedIOError — an OSError, i.e. transient
+under the default retry classification), ``fatal`` (raise
+FatalFaultError — never retried; drives the multi-host failure
+agreement), ``preempt`` (SIGTERM to self — deterministic mid-run
+preemption), ``torn`` (truncate the file/meta at the ``path`` the site
+passed — simulates a torn write discovered at the next load; only
+meaningful at ckpt.finalize).
+
+Every firing emits a ``fault_injected`` telemetry event, so chaos runs
+are auditable from the JSONL alone.  Zero-cost when disabled: with no
+plan installed ``fire()`` is one global load + None check, and the
+producer hot path doesn't even pay that — pipeline.py wraps its
+per-step host work only when ``targets(site)`` is true at epoch setup.
+
+**RetryPolicy.**  Bounded retries with exponential backoff and
+deterministic jitter (seeded per site, so a fixed plan seed reproduces
+the exact schedule), transient-vs-fatal classification, and a per-site
+wall-clock deadline.  The deadline bounds RETRYING, not the call itself:
+an in-flight call is never interrupted (Python offers no safe
+preemption), but no new attempt starts past the deadline.  Wrapped
+around dataset reads, checkpoint write/restore/finalize, and
+jax.distributed init.  ``retry/attempts`` counts extra attempts,
+``retry/giveups`` exhausted policies — both land in the telemetry
+report.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import random
+import signal
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple, TypeVar
+
+from . import telemetry
+
+T = TypeVar("T")
+
+KINDS = ("ioerror", "fatal", "preempt", "torn")
+
+SITES = ("data.read", "data.host_batch", "ckpt.save", "ckpt.finalize",
+         "ckpt.restore", "runtime.init", "telemetry.write")
+
+
+class InjectedIOError(OSError):
+    """A transient injected failure (kind=ioerror): an OSError, so the
+    default retry classification treats it exactly like a real flaky
+    read/write."""
+
+
+class FatalFaultError(RuntimeError):
+    """A non-transient injected failure (kind=fatal): never retried;
+    the rank that hits it must fail loudly and notify its peers."""
+
+
+class PeerFailureError(RuntimeError):
+    """Raised on HEALTHY ranks after the failure-agreement all-reduce
+    reports that some other rank hit a fatal error: every rank leaves
+    the training loop at the same boundary instead of hanging in the
+    dead rank's next collective."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One planned fault: fires on hits (after_n, after_n+count] of
+    ``site``, optionally restricted to one rank / a path substring."""
+
+    site: str
+    kind: str
+    after_n: int = 0
+    count: int = 1
+    rank: Optional[int] = None
+    path_match: Optional[str] = None
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(f"unknown fault site {self.site!r} "
+                             f"(expected one of {', '.join(SITES)})")
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(expected one of {', '.join(KINDS)})")
+        if self.after_n < 0 or self.count < 1:
+            raise ValueError(
+                f"fault {self.site}:{self.kind}: after_n must be >= 0 "
+                f"and count >= 1 (got {self.after_n}, {self.count})")
+
+
+class FaultPlan:
+    """An installed set of FaultSpecs plus per-site hit counters.
+
+    Hit counting is per (site, path_match-bucket)-free: one counter per
+    site, shared by all specs targeting it, incremented on every
+    ``fire(site)`` call that any spec targets — deterministic for a
+    fixed plan because the framework's call sequence is deterministic.
+    Thread-safe: producer threads and the driver share the counters.
+    """
+
+    def __init__(self, specs: List[FaultSpec], seed: int = 0):
+        self.specs = list(specs)
+        self.seed = int(seed)
+        self._sites = frozenset(s.site for s in self.specs)
+        self._hits: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._rank: Optional[int] = None
+
+    def targets(self, site: str) -> bool:
+        return site in self._sites
+
+    def _current_rank(self) -> int:
+        if self._rank is None:
+            try:
+                import jax
+
+                self._rank = int(jax.process_index())
+            except Exception:  # jax absent/uninitializable: single rank
+                self._rank = 0
+        return self._rank
+
+    def fire(self, site: str, path: Optional[str] = None) -> None:
+        """Count a hit of ``site`` and act on any spec that matches.
+
+        Raises for ioerror/fatal kinds; preempt signals self; torn
+        truncates the file at ``path`` and returns (the site carries on
+        — the damage is discovered at the next load, like a real torn
+        write).
+        """
+        if site not in self._sites:
+            return
+        with self._lock:
+            hit = self._hits.get(site, 0) + 1
+            self._hits[site] = hit
+        for spec in self.specs:
+            if spec.site != site:
+                continue
+            if not (spec.after_n < hit <= spec.after_n + spec.count):
+                continue
+            if spec.rank is not None \
+                    and spec.rank != self._current_rank():
+                continue
+            if spec.path_match is not None \
+                    and (path is None or spec.path_match not in path):
+                continue
+            self._act(spec, hit, path)
+
+    def _act(self, spec: FaultSpec, hit: int,
+             path: Optional[str]) -> None:
+        tel = telemetry.get()
+        tel.event("fault_injected", site=spec.site, kind=spec.kind,
+                  hit=hit, **({"path": path} if path else {}))
+        logging.warning(f"FAULT INJECTED at {spec.site} "
+                        f"(kind={spec.kind}, hit #{hit}"
+                        + (f", path={path}" if path else "") + ")")
+        if spec.kind == "ioerror":
+            raise InjectedIOError(
+                f"injected transient I/O error at {spec.site} "
+                f"(hit #{hit})")
+        if spec.kind == "fatal":
+            raise FatalFaultError(
+                f"injected fatal fault at {spec.site} (hit #{hit})")
+        if spec.kind == "preempt":
+            os.kill(os.getpid(), signal.SIGTERM)
+            return
+        if spec.kind == "torn":
+            _tear(path)
+
+
+def _tear(path: Optional[str]) -> None:
+    """Simulate a torn write: truncate the file at ``path`` to half its
+    size (an orbax directory gets ONE of its payload files torn), then
+    let the site carry on — the corruption is only discovered when the
+    checkpoint is next read and its checksum verified."""
+    if path is None or not os.path.exists(path):
+        logging.warning(f"torn fault: nothing to tear at {path!r}")
+        return
+    target = path
+    if os.path.isdir(path):
+        candidates = sorted(
+            os.path.join(dirpath, fn)
+            for dirpath, _, fns in os.walk(path) for fn in fns
+            if fn != "meta.json" and os.path.getsize(
+                os.path.join(dirpath, fn)) > 1)
+        if not candidates:
+            logging.warning(f"torn fault: no payload files under {path!r}")
+            return
+        target = candidates[0]
+    size = os.path.getsize(target)
+    with open(target, "r+b") as f:
+        f.truncate(max(1, size // 2))
+    logging.warning(f"torn fault: truncated {target!r} "
+                    f"{size} -> {max(1, size // 2)} bytes")
+
+
+# -- plan parsing ------------------------------------------------------
+
+
+def parse_plan(text: str, seed: int = 0) -> FaultPlan:
+    """``--fault-plan`` argument -> FaultPlan.
+
+    A path to an existing ``.json`` file (or any existing file) is the
+    JSON form; anything else is the inline DSL.
+    """
+    if text.endswith(".json") or os.path.exists(text):
+        try:
+            with open(text) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            raise ValueError(
+                f"cannot read fault plan file {text!r}: {e}") from e
+        if not isinstance(doc, dict) or not isinstance(
+                doc.get("faults"), list):
+            raise ValueError(
+                f"fault plan file {text!r} must be a JSON object with a "
+                "'faults' list (and an optional 'seed')")
+        specs = []
+        for i, entry in enumerate(doc["faults"]):
+            if not isinstance(entry, dict):
+                raise ValueError(
+                    f"fault plan file {text!r}: faults[{i}] is not an "
+                    "object")
+            unknown = set(entry) - {"site", "kind", "after_n", "count",
+                                    "rank", "path_match"}
+            if unknown:
+                raise ValueError(
+                    f"fault plan file {text!r}: faults[{i}] has unknown "
+                    f"key(s) {sorted(unknown)}")
+            specs.append(FaultSpec(**entry))
+        return FaultPlan(specs, seed=int(doc.get("seed", seed)))
+    specs = []
+    for part in text.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) not in (3, 4):
+            raise ValueError(
+                f"bad fault spec {part!r}: expected "
+                "'site:kind:after_n[:count]'")
+        try:
+            after_n = int(fields[2])
+            count = int(fields[3]) if len(fields) == 4 else 1
+        except ValueError as e:
+            raise ValueError(
+                f"bad fault spec {part!r}: after_n/count must be "
+                "integers") from e
+        specs.append(FaultSpec(site=fields[0], kind=fields[1],
+                               after_n=after_n, count=count))
+    if not specs:
+        raise ValueError(f"empty fault plan {text!r}")
+    return FaultPlan(specs, seed=seed)
+
+
+# -- module-level installation (zero-cost when absent) -----------------
+
+_plan: Optional[FaultPlan] = None
+
+
+def install(plan: Optional[FaultPlan]) -> None:
+    """Install (or clear, with None) the process's fault plan."""
+    global _plan
+    _plan = plan
+
+
+def installed() -> Optional[FaultPlan]:
+    return _plan
+
+
+def targets(site: str) -> bool:
+    """True when the installed plan has a spec for ``site`` — hot paths
+    check this ONCE at setup and skip all fault plumbing otherwise."""
+    return _plan is not None and _plan.targets(site)
+
+
+def fire(site: str, path: Optional[str] = None) -> None:
+    """Injection point: no-op (one None check) without a plan."""
+    plan = _plan
+    if plan is not None:
+        plan.fire(site, path)
+
+
+# -- retry policy ------------------------------------------------------
+
+# Transient by default: OS-level I/O errors and timeouts (includes
+# InjectedIOError and ConnectionError, both OSError subclasses).
+TRANSIENT = (OSError, TimeoutError)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded retry with deterministic jittered exponential backoff.
+
+    ``timeout_s`` is a per-call-site retry deadline: once the first
+    attempt started more than ``timeout_s`` ago, no further attempt is
+    made (the in-flight attempt itself is never interrupted).
+    """
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    timeout_s: float = 60.0
+    seed: int = 0
+
+    def _delay(self, site: str, attempt: int) -> float:
+        backoff = min(self.max_delay_s,
+                      self.base_delay_s * (2.0 ** (attempt - 1)))
+        # Deterministic per (seed, site, attempt): a fixed plan seed
+        # reproduces the exact retry schedule on every run.
+        h = hashlib.sha256(
+            f"{self.seed}:{site}:{attempt}".encode()).digest()
+        rng = random.Random(int.from_bytes(h[:8], "big"))
+        return backoff * (0.5 + 0.5 * rng.random())
+
+    def call(self, fn: Callable[[], T], site: str,
+             transient: Tuple[type, ...] = TRANSIENT) -> T:
+        """Run ``fn`` under this policy.  Exceptions outside
+        ``transient`` (FatalFaultError in particular) propagate
+        immediately, attempt 1 included."""
+        tel = telemetry.get()
+        deadline = time.monotonic() + self.timeout_s
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return fn()
+            except FatalFaultError:
+                raise
+            except transient as e:
+                out_of_time = time.monotonic() >= deadline
+                if attempt >= self.max_attempts or out_of_time:
+                    tel.counter("retry/giveups").add(1)
+                    tel.event("retry_giveup", site=site, attempts=attempt,
+                              error=str(e), timed_out=out_of_time)
+                    logging.error(
+                        f"{site}: giving up after {attempt} attempt(s)"
+                        + (" (retry deadline exceeded)" if out_of_time
+                           else "") + f": {e}")
+                    raise
+                delay = self._delay(site, attempt)
+                tel.counter("retry/attempts").add(1)
+                tel.event("retry", site=site, attempt=attempt,
+                          delay_s=delay, error=str(e))
+                logging.warning(
+                    f"{site}: transient failure (attempt {attempt}/"
+                    f"{self.max_attempts}), retrying in {delay:.3f}s: {e}")
+                time.sleep(delay)
+
+
+_default_policy = RetryPolicy()
+
+
+def configure(fault_plan: Optional[str] = None, fault_seed: int = 0,
+              retry_max_attempts: int = 3,
+              retry_base_delay_s: float = 0.05,
+              retry_timeout_s: float = 60.0) -> None:
+    """Install the process's fault plan + default retry policy from the
+    run Config (drivers call this once, before runtime init so the
+    runtime.init site is live).  ``fault_plan=None`` clears any plan —
+    re-invocation safe, same convention as telemetry.configure."""
+    global _default_policy
+    install(parse_plan(fault_plan, seed=fault_seed)
+            if fault_plan else None)
+    _default_policy = RetryPolicy(max_attempts=retry_max_attempts,
+                                  base_delay_s=retry_base_delay_s,
+                                  timeout_s=retry_timeout_s,
+                                  seed=fault_seed)
+
+
+def policy() -> RetryPolicy:
+    """The process's default retry policy (library call sites use this
+    so they never see the Config)."""
+    return _default_policy
+
+
+def retry(fn: Callable[[], T], site: str,
+          transient: Tuple[type, ...] = TRANSIENT) -> T:
+    """``policy().call`` shorthand for library call sites."""
+    return _default_policy.call(fn, site, transient)

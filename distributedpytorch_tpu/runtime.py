@@ -31,6 +31,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import compat  # noqa: F401  (jax version shims, PRNG config)
+from . import faults
 
 # Canonical mesh axis names.  Data parallelism ('data') is the reference's
 # one and only strategy (SURVEY §2 parallelism checklist); 'model' exists so
@@ -102,10 +103,19 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
         except Exception:  # older/newer jax without the option
             pass
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id)
+        def _init():
+            # The rendezvous is the canonical transient failure (the
+            # coordinator not up yet, a blipped tunnel): retried under
+            # the process retry policy.  RuntimeError is how
+            # jax.distributed surfaces a failed/timed-out rendezvous.
+            faults.fire("runtime.init")
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id)
+
+        faults.retry(_init, "runtime.init",
+                     transient=(OSError, TimeoutError, RuntimeError))
     _initialized = True
 
 
@@ -201,6 +211,31 @@ def any_process(flag: bool) -> bool:
     return bool(np.any(flags))
 
 
+def agree_health(failed: bool, shutdown: bool) -> tuple:
+    """(any_failed, any_shutdown) across every process — ONE allgather.
+
+    The failure-agreement extension of ``any_process``: a rank that hit
+    a fatal error at a loop boundary reports ``failed=True`` here
+    instead of raising straight out of the loop, so its peers learn of
+    the failure through a collective they ALL reach (in the same
+    program order) rather than hanging forever in the dead rank's next
+    epoch collective.  The caller then re-raises locally on the failed
+    rank and raises ``faults.PeerFailureError`` on the healthy ones —
+    every rank exits cleanly, same boundary, nonzero.
+
+    Folding both flags into one message keeps the collective schedule
+    identical to the old single-flag health check (no extra rendezvous
+    per boundary).  Single-process: no communication.
+    """
+    if jax.process_count() == 1:
+        return bool(failed), bool(shutdown)
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.array([failed, shutdown], dtype=bool))
+    return bool(np.any(flags[..., 0])), bool(np.any(flags[..., 1]))
+
+
 _cache_hits = 0
 _cache_listener_installed = False
 
@@ -216,6 +251,25 @@ def compilation_cache_hits() -> int:
     jax.monitoring).  Consumers snapshot before a compile and diff after
     — e.g. the --aot-warmup compile/cache_hit telemetry gauge."""
     return _cache_hits
+
+
+def donation_safe() -> bool:
+    """False when jitted programs must NOT use ``donate_argnums``.
+
+    On the CPU backend, an executable served from the persistent
+    compilation cache comes back with broken input-output aliasing
+    metadata: the first donated dispatch is fine, but feeding its output
+    back in as the next donated input reuses freed buffers — NaN params
+    or a segfault, observed exactly on resume (a fresh process whose
+    every compile is a disk-cache hit).  TPU/GPU executable
+    serialization round-trips aliasing correctly, and CPU without the
+    cache is fine, so donation is disabled only for the one broken
+    combination.  Donation on CPU is a memory optimization, never a
+    correctness requirement, so dropping it is free.
+    """
+    if jax.default_backend() != "cpu":
+        return True
+    return getattr(jax.config, "jax_compilation_cache_dir", None) is None
 
 
 def configure_compilation_cache(cache_dir: Optional[str]) -> None:

@@ -50,6 +50,7 @@ thread's parent chain.
 from __future__ import annotations
 
 import json
+import logging
 import math
 import os
 import threading
@@ -194,6 +195,8 @@ class Telemetry:
         self._buffer: List[str] = []
         self._lock = threading.Lock()
         self._file = None
+        self.write_errors = 0
+        self._sink_dead = False
 
     @property
     def _span_stack(self) -> List[str]:
@@ -252,11 +255,30 @@ class Telemetry:
     def _write_locked(self) -> None:
         if not self._buffer:
             return
-        if self._file is None:
-            os.makedirs(self._dir, exist_ok=True)
-            self._file = open(self._path, "a", encoding="utf-8")
-        self._file.write("\n".join(self._buffer) + "\n")
-        self._file.flush()
+        if self._sink_dead:
+            # An earlier write failed: telemetry is observability, not
+            # training state — drop events rather than retry a dead disk
+            # on every flush (the report shows the write_errors count).
+            self._buffer.clear()
+            return
+        try:
+            from . import faults
+
+            faults.fire("telemetry.write")
+            if self._file is None:
+                os.makedirs(self._dir, exist_ok=True)
+                self._file = open(self._path, "a", encoding="utf-8")
+            self._file.write("\n".join(self._buffer) + "\n")
+            self._file.flush()
+        except OSError as e:
+            # A full/unwritable disk must NEVER kill training (ISSUE 5
+            # satellite): count it, disable this rank's sink, train on.
+            self.write_errors += 1
+            self._sink_dead = True
+            logging.error(
+                f"telemetry: cannot write {self._path!r} ({e}); "
+                f"disabling further telemetry writes for rank "
+                f"{self.rank} — training continues")
         self._buffer.clear()
 
     def flush(self) -> None:
@@ -274,6 +296,13 @@ class Telemetry:
         summary block."""
         if not self.enabled:
             return
+        if self.write_errors:
+            self.counter("telemetry/write_errors").add(self.write_errors)
+            # One last attempt for the summaries below: the condition
+            # (disk full, quota) may have cleared since the failure, and
+            # the write_errors counter is how the report learns events
+            # were dropped.  Failing again just re-kills the sink.
+            self._sink_dead = False
         for c in self._counters.values():
             self._emit({"kind": "counter", "name": c.name,
                         "value": c.value})
@@ -430,6 +459,23 @@ def render_report(agg: Dict[str, Any]) -> str:
     if agg.get("skipped_events"):
         lines.append(f"({agg['skipped_events']} malformed event(s) "
                      f"skipped)")
+    # Writer-failure visibility (ISSUE 5 satellite): a rank whose JSONL
+    # sink died mid-run reports a write_errors counter if its final
+    # close-time write landed — and if it didn't, the rank is simply
+    # missing from the files, which the run_start processes attr exposes.
+    werr = agg["counters"].get("telemetry/write_errors")
+    if werr:
+        lines.append(f"WARNING: {int(werr)} telemetry write error(s) — "
+                     f"some events were dropped (see run log)")
+    expected = max((int(e.get("attrs", {}).get("processes", 0))
+                    for e in agg["events"]
+                    if e.get("name") == "run_start"), default=0)
+    if expected > len(agg["ranks"]):
+        missing = sorted(set(range(expected)) - set(agg["ranks"]))
+        lines.append(f"WARNING: {expected} process(es) ran but only "
+                     f"{len(agg['ranks'])} rank file(s) readable — "
+                     f"rank(s) {missing} skipped (telemetry writer "
+                     f"disabled or file lost)")
 
     spans = agg["spans"]
     if spans:

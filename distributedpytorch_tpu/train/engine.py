@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from .. import runtime
 from ..data import augment
 from ..models.registry import (AUX_LOGIT_MODELS, DROPOUT_MODELS,
                                trainable_mask)
@@ -97,13 +98,18 @@ class Engine:
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         self.grad_accum = int(grad_accum)
-        self.train_step = jax.jit(self._train_step, donate_argnums=0)
+        # State donation is dropped where the persistent compilation
+        # cache would corrupt it (CPU cache-hit executables lose their
+        # aliasing metadata — see runtime.donation_safe).
+        donate = (0,) if runtime.donation_safe() else ()
+        self.train_step = jax.jit(self._train_step, donate_argnums=donate)
         self.eval_step = jax.jit(self._eval_step)
         # Device-resident whole-epoch programs (see train_epoch/eval_epoch):
         # one XLA dispatch per epoch instead of one per step.
-        self.train_epoch = jax.jit(self._train_epoch, donate_argnums=0)
+        self.train_epoch = jax.jit(self._train_epoch, donate_argnums=donate)
         self.eval_epoch = jax.jit(self._eval_epoch)
-        self.train_epochs = jax.jit(self._train_epochs, donate_argnums=0)
+        self.train_epochs = jax.jit(self._train_epochs,
+                                    donate_argnums=donate)
 
     # -- state ------------------------------------------------------------
 

@@ -1,0 +1,277 @@
+#!/usr/bin/env python3
+"""Chaos gate leg (scripts/gate.sh): every failure path, end to end.
+
+Four stages, all CPU and bounded:
+
+  A. reference — a fault-free 3-epoch synthetic run; its final params
+     are the recovery target.
+  B. chaos — the same run under a canned fault plan: two transient
+     dataset-read errors (must be retried, with ``retry/attempts`` in
+     the telemetry), a mid-run SIGTERM during epoch 1's rolling save
+     (must preempt cleanly at the epoch boundary), and a torn write of
+     that same rolling file (head checkpoint left corrupt on disk).
+  C. resume — restart from the TORN head: the lineage fallback must
+     reject it loudly (``ckpt_fallback`` event), fall back to the
+     epoch-0 snapshot, finish the remaining epochs, and land on final
+     params equal to the reference run's.
+  D. failure agreement — two real processes (gloo rendezvous) with a
+     fatal fault injected on rank 0 only: BOTH ranks must exit nonzero
+     within the deadline (no hang), and both telemetry JSONLs must
+     carry the ``peer_failure`` event.
+
+Run as ``env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py``.
+The script re-execs itself with ``--child`` for stage D's ranks.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EPOCHS = 3
+CHILD_EXIT = 7          # the children's agreed-failure exit status
+CHILD_DEADLINE_S = 420.0
+
+CHAOS_PLAN = {
+    "seed": 0,
+    "faults": [
+        # Transient dataset reads: retried, never fatal.
+        {"site": "data.read", "kind": "ioerror", "after_n": 0, "count": 2},
+        # ckpt.save/ckpt.finalize hit order is deterministic: epoch 0
+        # writes rolling (hit 1) then best (hit 2, best always improves
+        # from inf); epoch 1's rolling save is hit 3 on both sites.
+        {"site": "ckpt.save", "kind": "preempt", "after_n": 2, "count": 1},
+        {"site": "ckpt.finalize", "kind": "torn", "after_n": 2, "count": 1,
+         "path_match": "checkpoint-"},
+    ],
+}
+
+
+def _events(rsl: str, rank: int = 0) -> list:
+    path = os.path.join(rsl, "telemetry", f"rank{rank}.jsonl")
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _named(events: list, name: str) -> list:
+    return [e for e in events
+            if e.get("kind") == "event" and e.get("name") == name]
+
+
+def _base_cfg(rsl: str):
+    from distributedpytorch_tpu.config import Config
+
+    return Config(action="train", data_path="/nodata", rsl_path=rsl,
+                  dataset="synthetic", model_name="mlp", batch_size=8,
+                  nb_epochs=EPOCHS, debug=True, half_precision=False,
+                  telemetry=True, keep_ckpts=EPOCHS)
+
+
+def _params(result) -> list:
+    import jax
+    import numpy as np
+
+    return [np.asarray(jax.device_get(leaf)) for leaf in
+            jax.tree_util.tree_leaves(result["state"].params)]
+
+
+def main() -> int:
+    from __graft_entry__ import _force_cpu_devices
+
+    _force_cpu_devices(1)
+
+    import numpy as np
+
+    from distributedpytorch_tpu import checkpoint as ckpt
+    from distributedpytorch_tpu import telemetry
+    from distributedpytorch_tpu.cli import run_train
+
+    problems = []
+    work = tempfile.mkdtemp(prefix="chaos_gate_")
+
+    # -- stage A: fault-free reference --------------------------------
+    ref = run_train(_base_cfg(os.path.join(work, "ref")))
+    ref_params = _params(ref)
+    print(f"chaos gate A: reference run done "
+          f"({len(ref['history'])} epochs)")
+
+    # -- stage B: transients + preempt + torn head --------------------
+    plan_path = os.path.join(work, "plan.json")
+    with open(plan_path, "w") as f:
+        json.dump(CHAOS_PLAN, f)
+    chaos_rsl = os.path.join(work, "chaos")
+    chaos = run_train(_base_cfg(chaos_rsl).replace(fault_plan=plan_path))
+    ev = _events(chaos_rsl)
+    agg = telemetry.aggregate(ev)
+    if not chaos["preempted"]:
+        problems.append("chaos run was not preempted — the injected "
+                        "SIGTERM (ckpt.save preempt fault) was lost")
+    if len(chaos["history"]) != 2:
+        problems.append(f"chaos run finished {len(chaos['history'])} "
+                        f"epochs, expected 2 (preempt after epoch 1)")
+    if agg["counters"].get("retry/attempts", 0) < 2:
+        problems.append("retry/attempts < 2 — the transient data.read "
+                        "faults were not retried (or not counted)")
+    if agg["counters"].get("retry/giveups", 0):
+        problems.append("retry/giveups > 0 — a transient fault "
+                        "exhausted the retry policy")
+    fired = _named(ev, "fault_injected")
+    kinds = sorted(e["attrs"]["kind"] for e in fired)
+    if kinds != ["ioerror", "ioerror", "preempt", "torn"]:
+        problems.append(f"fault_injected events {kinds} != the planned "
+                        f"[ioerror x2, preempt, torn]")
+    if not _named(ev, "preempt"):
+        problems.append("no preempt event — the SIGTERM was not "
+                        "surfaced at the epoch boundary")
+    head = ckpt.checkpoint_path(chaos_rsl, "synthetic", "mlp", 1)
+    if ckpt.verify_checkpoint(head) is None:
+        problems.append(f"head checkpoint {head} verifies clean — the "
+                        f"torn fault did not corrupt it")
+    print(f"chaos gate B: chaos run preempted after "
+          f"{len(chaos['history'])} epochs, "
+          f"{int(agg['counters'].get('retry/attempts', 0))} retries, "
+          f"head torn")
+
+    # -- stage C: resume from the torn head ---------------------------
+    resume = run_train(_base_cfg(chaos_rsl).replace(checkpoint_file=head))
+    ev = _events(chaos_rsl)
+    fallbacks = _named(ev, "ckpt_fallback")
+    if not fallbacks:
+        problems.append("no ckpt_fallback event — the torn head was not "
+                        "loudly rejected on resume")
+    resumed_epochs = [h["epoch"] for h in resume["history"]]
+    if resumed_epochs != [1, 2]:
+        problems.append(f"resume ran epochs {resumed_epochs}, expected "
+                        f"[1, 2] (fallback to the epoch-0 snapshot)")
+    res_params = _params(resume)
+    if len(res_params) != len(ref_params) or not all(
+            np.allclose(a, b, rtol=1e-5, atol=1e-6)
+            for a, b in zip(ref_params, res_params)):
+        problems.append("resumed final params differ from the "
+                        "fault-free reference run's — recovery is not "
+                        "bit-compatible")
+    print(f"chaos gate C: resumed past torn head "
+          f"({len(fallbacks)} fallback event(s)), params match "
+          f"reference")
+
+    # -- stage D: two-rank fatal-failure agreement --------------------
+    problems += _stage_fatal_agreement(work, plan_dir=work)
+
+    for p in problems:
+        print(f"PROBLEM: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print("chaos gate OK: retries, preemption, torn-head fallback and "
+          "multi-rank failure agreement all green")
+    return 0
+
+
+def _stage_fatal_agreement(work: str, plan_dir: str) -> list:
+    """Stage D driver: spawn 2 ranks of this same script, rank 0 carrying
+    a fatal fault at its first checkpoint save; both must exit CHILD_EXIT
+    before the deadline and both JSONLs must carry peer_failure."""
+    import socket
+
+    problems = []
+    plan_path = os.path.join(plan_dir, "fatal_plan.json")
+    with open(plan_path, "w") as f:
+        json.dump({"faults": [{"site": "ckpt.save", "kind": "fatal",
+                               "after_n": 0, "count": 1, "rank": 0}]}, f)
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        coord = f"localhost:{s.getsockname()[1]}"
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs, logs, rsls = [], [], []
+    for pid in range(2):
+        rsl = os.path.join(work, f"fatal_rank{pid}")
+        log = os.path.join(work, f"fatal_rank{pid}.log")
+        rsls.append(rsl)
+        logs.append(log)
+        # A log FILE, never a pipe: an undrained pipe backpressures a
+        # chatty child into blocking mid-collective and deadlocks both.
+        out = open(log, "ab")
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             "--coord", coord, "--pid", str(pid),
+             "--plan", plan_path, "--rsl", rsl],
+            cwd=REPO, env=env, stdout=out, stderr=out))
+
+    deadline = time.monotonic() + CHILD_DEADLINE_S
+    for pid, p in enumerate(procs):
+        try:
+            rc = p.wait(timeout=max(1.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            problems.append(
+                f"rank {pid} HUNG past {CHILD_DEADLINE_S:.0f}s — failure "
+                f"agreement broken\n{_tail(logs[pid])}")
+            continue
+        if rc != CHILD_EXIT:
+            problems.append(
+                f"rank {pid} exited rc={rc}, expected {CHILD_EXIT} "
+                f"(agreed fatal exit)\n{_tail(logs[pid])}")
+    for pid, rsl in enumerate(rsls):
+        try:
+            if not _named(_events(rsl, rank=pid), "peer_failure"):
+                problems.append(f"rank {pid} JSONL has no peer_failure "
+                                f"event — the agreed exit left no trail")
+        except OSError:
+            problems.append(f"rank {pid} wrote no telemetry JSONL")
+    if not problems:
+        print("chaos gate D: both ranks exited the fatal fault "
+              "together, peer_failure in both JSONLs")
+    return problems
+
+
+def _tail(path: str, n: int = 2500) -> str:
+    try:
+        return open(path).read()[-n:]
+    except OSError:
+        return "<no log>"
+
+
+def child_main(a) -> int:
+    """One stage-D rank: join the gloo rendezvous, train under the fatal
+    plan, and exit CHILD_EXIT on the agreed failure path."""
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+    from distributedpytorch_tpu import faults, runtime
+    from distributedpytorch_tpu.cli import run_train
+
+    runtime.initialize_distributed(coordinator_address=a.coord,
+                                   num_processes=2, process_id=a.pid)
+    cfg = _base_cfg(a.rsl).replace(fault_plan=a.plan, nb_epochs=2,
+                                   batch_size=4)
+    try:
+        run_train(cfg)
+    except (faults.FatalFaultError, faults.PeerFailureError) as e:
+        print(f"rank {a.pid}: agreed fatal exit: {e}", file=sys.stderr)
+        return CHILD_EXIT
+    print(f"rank {a.pid}: run finished WITHOUT the fatal fault firing",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--coord")
+    ap.add_argument("--pid", type=int)
+    ap.add_argument("--plan")
+    ap.add_argument("--rsl")
+    args = ap.parse_args()
+    sys.exit(child_main(args) if args.child else main())

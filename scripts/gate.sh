@@ -14,7 +14,11 @@
 #   3. transfer-guard smoke: one CPU streaming epoch with device->host
 #      syncs disallowed outside the sanctioned per-epoch points — the
 #      runtime sanitizer for the paper's per-batch .item() bug class
-#   4. the driver's own gate: __graft_entry__.dryrun_multichip(8)
+#   4. chaos gate: a short CPU run under a canned fault plan (transient
+#      read errors, mid-run SIGTERM, torn head checkpoint, two-rank
+#      fatal fault) proving every failure path recovers — see
+#      scripts/chaos_gate.py and README "Fault tolerance & chaos testing"
+#   5. the driver's own gate: __graft_entry__.dryrun_multichip(8)
 #      (clean env, exactly as the driver runs it)
 #
 # Tier map:
@@ -52,6 +56,9 @@ env -u XLA_FLAGS -u JAX_PLATFORMS python scripts/overlap_gate.py
 
 echo "== gate: transfer-guard smoke (runtime sanitizer) =="
 env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/graftlint.py --smoke
+
+echo "== gate: chaos (fault injection / retry / lineage recovery) =="
+env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py
 
 echo "== gate: dryrun_multichip(8) =="
 env -u XLA_FLAGS -u JAX_PLATFORMS python -c \

@@ -1,0 +1,182 @@
+"""Checkpoint lineage (ISSUE 5): content checksums in the rolling
+ledger, verify-on-load, loud fallback past a torn head to the newest
+valid snapshot, keep-K rotation, AsyncSaver degrade-to-sync, and the
+one-line actionable errors for missing/garbage orbax meta.json."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu import checkpoint as ckpt
+from distributedpytorch_tpu import telemetry
+from distributedpytorch_tpu.models import get_model
+from distributedpytorch_tpu.ops.losses import get_loss_fn
+from distributedpytorch_tpu.train.engine import Engine, make_optimizer
+
+
+@pytest.fixture
+def restore_global():
+    yield
+    telemetry._active = telemetry.Telemetry(enabled=False)
+
+
+@pytest.fixture(scope="module")
+def trained_state():
+    model = get_model("mlp", 10, half_precision=False)
+    tx = make_optimizer("adam", 1e-3, 0.9, 0.1, 4, False)
+    engine = Engine(model, "mlp", get_loss_fn("cross_entropy"), tx,
+                    mean=0.45, std=0.2, input_size=28,
+                    half_precision=False)
+    return engine, engine.init_state(jax.random.PRNGKey(7))
+
+
+def _save_epochs(rsl, state, epochs):
+    paths = []
+    for e in epochs:
+        p = ckpt.checkpoint_path(rsl, "synthetic", "mlp", e)
+        ckpt.save_checkpoint(p, "mlp", state, e, 0.5 - 0.1 * e)
+        paths.append(p)
+    return paths
+
+
+# -- lineage ledger + verify-on-load -----------------------------------
+
+
+def test_save_records_lineage_and_verifies(tmp_path, trained_state):
+    _, state = trained_state
+    (path,) = _save_epochs(str(tmp_path), state, [0])
+    doc = json.load(open(ckpt.lineage_path(str(tmp_path))))
+    (rec,) = [r for r in doc["records"]
+              if r["file"] == os.path.basename(path)]
+    assert rec["epoch"] == 0 and rec["bytes"] == os.path.getsize(path)
+    assert len(rec["sha256"]) == 64
+    assert ckpt.verify_checkpoint(path) is None
+
+
+def test_verify_detects_torn_file(tmp_path, trained_state):
+    _, state = trained_state
+    (path,) = _save_epochs(str(tmp_path), state, [0])
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size // 2)
+    reason = ckpt.verify_checkpoint(path)
+    assert reason is not None and "checksum mismatch" in reason
+
+
+def test_unrecorded_file_stays_loadable(tmp_path, trained_state):
+    # pre-lineage checkpoints (no ledger entry) must not be rejected
+    _, state = trained_state
+    (path,) = _save_epochs(str(tmp_path), state, [0])
+    os.remove(ckpt.lineage_path(str(tmp_path)))
+    assert ckpt.verify_checkpoint(path) is None
+    ckpt.load_checkpoint(path, state)
+
+
+# -- fallback past a torn head -----------------------------------------
+
+
+def test_fallback_skips_torn_head_loudly(tmp_path, trained_state,
+                                         restore_global):
+    telemetry._active = telemetry.Telemetry(
+        enabled=True, rsl_path=str(tmp_path), rank=0)
+    _, state = trained_state
+    p0, p1 = _save_epochs(str(tmp_path), state, [0, 1])
+    with open(p1, "r+b") as f:  # tear the newest (head) snapshot
+        f.truncate(os.path.getsize(p1) // 2)
+    _, start_epoch, _ = ckpt.load_checkpoint_with_fallback(
+        p1, state, str(tmp_path), "synthetic", "mlp")
+    assert start_epoch == 1  # resumed from epoch 0 -> next epoch is 1
+    telemetry.get().close()
+    events = [json.loads(l) for l in
+              open(tmp_path / "telemetry" / "rank0.jsonl")]
+    fb = [e for e in events if e.get("kind") == "event"
+          and e.get("name") == "ckpt_fallback"]
+    assert len(fb) == 1
+    assert fb[0]["attrs"]["skipped"] == os.path.basename(p1)
+
+
+def test_fallback_exhausted_is_actionable(tmp_path, trained_state):
+    _, state = trained_state
+    (p0,) = _save_epochs(str(tmp_path), state, [0])
+    with open(p0, "r+b") as f:
+        f.truncate(os.path.getsize(p0) // 2)
+    with pytest.raises(ValueError, match="no valid checkpoint"):
+        ckpt.load_checkpoint_with_fallback(
+            p0, state, str(tmp_path), "synthetic", "mlp")
+
+
+# -- keep-K rotation ---------------------------------------------------
+
+
+def test_rotation_keeps_k_newest(tmp_path, trained_state):
+    _, state = trained_state
+    rsl = str(tmp_path)
+    for e in range(4):
+        _save_epochs(rsl, state, [e])
+        ckpt.rotate_checkpoint(rsl, "synthetic", "mlp", e, keep=2)
+    kept = ckpt.list_checkpoints(rsl, "synthetic", "mlp")
+    assert [os.path.basename(p) for p in kept] == [
+        "checkpoint-synthetic-mlp-003.ckpt",
+        "checkpoint-synthetic-mlp-002.ckpt"]
+    # rotated-away files are pruned from the ledger too
+    doc = json.load(open(ckpt.lineage_path(rsl)))
+    assert {r["file"] for r in doc["records"]} == {
+        os.path.basename(p) for p in kept}
+
+
+# -- AsyncSaver degrade-to-sync ----------------------------------------
+
+
+def test_saver_degrade_switches_to_sync(restore_global):
+    saver = ckpt.AsyncSaver(on_error="degrade")
+    ran = []
+
+    def boom():
+        raise OSError("disk full")
+
+    saver.submit(boom)
+    saver.wait()  # with on_error='raise' this would re-raise
+    assert saver.degraded
+    saver.submit(lambda: ran.append("sync"))  # runs on THIS thread
+    assert ran == ["sync"]
+    saver.close()
+
+
+def test_saver_default_still_raises():
+    saver = ckpt.AsyncSaver()
+
+    def boom():
+        raise OSError("disk full")
+
+    saver.submit(boom)
+    with pytest.raises(OSError, match="disk full"):
+        saver.wait()
+    saver.close()
+
+
+# -- orbax meta.json actionable errors (ISSUE 5 satellite) -------------
+
+
+def test_missing_meta_is_one_line_actionable(tmp_path):
+    d = tmp_path / "notackpt"
+    d.mkdir()
+    with pytest.raises(ValueError) as ei:
+        ckpt.load_checkpoint(str(d), None)
+    msg = str(ei.value)
+    assert "missing meta.json" in msg and "--ckpt-format orbax" in msg
+    assert "\n" not in msg  # ONE line, not a traceback dump
+
+
+def test_garbage_meta_is_one_line_actionable(tmp_path):
+    d = tmp_path / "corrupt"
+    d.mkdir()
+    (d / "meta.json").write_text("not json {")
+    with pytest.raises(ValueError) as ei:
+        ckpt.load_checkpoint(str(d), None)
+    msg = str(ei.value)
+    assert "garbage meta.json" in msg
+    assert "restore from" in msg  # says what to DO about it
+    assert "\n" not in msg

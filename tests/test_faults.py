@@ -1,0 +1,234 @@
+"""Fault-injection harness + retry policy (faults.py): plan parsing
+(inline DSL and JSON file), deterministic site firing and hit windows,
+retry schedule determinism, transient-vs-fatal classification, the
+retry telemetry trail, and the zero-cost-when-disabled contract."""
+
+import json
+import os
+
+import pytest
+
+from distributedpytorch_tpu import faults, telemetry
+
+
+@pytest.fixture(autouse=True)
+def clean_plan():
+    """Every test starts and ends with no installed plan — the module
+    global must never leak between tests (or into the rest of the
+    suite, where it would fire faults inside unrelated runs)."""
+    faults.install(None)
+    yield
+    faults.install(None)
+    telemetry._active = telemetry.Telemetry(enabled=False)
+
+
+# -- plan parsing ------------------------------------------------------
+
+
+def test_dsl_parses_sites_kinds_and_windows():
+    plan = faults.parse_plan(
+        "data.read:ioerror:0:2; ckpt.save:preempt:2", seed=7)
+    assert plan.seed == 7
+    assert [s.site for s in plan.specs] == ["data.read", "ckpt.save"]
+    assert plan.specs[0].kind == "ioerror"
+    assert (plan.specs[0].after_n, plan.specs[0].count) == (0, 2)
+    assert (plan.specs[1].after_n, plan.specs[1].count) == (2, 1)
+    assert plan.targets("data.read") and plan.targets("ckpt.save")
+    assert not plan.targets("ckpt.restore")
+
+
+def test_json_plan_roundtrips_with_filters(tmp_path):
+    doc = {"seed": 3, "faults": [
+        {"site": "ckpt.finalize", "kind": "torn", "after_n": 1,
+         "count": 1, "rank": 0, "path_match": "checkpoint-"}]}
+    path = tmp_path / "plan.json"
+    path.write_text(json.dumps(doc))
+    plan = faults.parse_plan(str(path))
+    assert plan.seed == 3
+    spec = plan.specs[0]
+    assert (spec.rank, spec.path_match) == (0, "checkpoint-")
+
+
+@pytest.mark.parametrize("bad, match", [
+    ("nosuch.site:ioerror:0", "unknown fault site"),
+    ("data.read:explode:0", "unknown fault kind"),
+    ("data.read:ioerror", "expected 'site:kind:after_n"),
+    ("data.read:ioerror:x", "must be integers"),
+    ("", "empty fault plan"),
+])
+def test_bad_dsl_is_actionable(bad, match):
+    with pytest.raises(ValueError, match=match):
+        faults.parse_plan(bad)
+
+
+def test_bad_json_plan_is_actionable(tmp_path):
+    garbage = tmp_path / "plan.json"
+    garbage.write_text("not json {")
+    with pytest.raises(ValueError, match="cannot read fault plan"):
+        faults.parse_plan(str(garbage))
+    wrong_shape = tmp_path / "shape.json"
+    wrong_shape.write_text(json.dumps({"faults": "nope"}))
+    with pytest.raises(ValueError, match="'faults' list"):
+        faults.parse_plan(str(wrong_shape))
+    unknown_key = tmp_path / "key.json"
+    unknown_key.write_text(json.dumps(
+        {"faults": [{"site": "data.read", "kind": "ioerror",
+                     "when": "later"}]}))
+    with pytest.raises(ValueError, match="unknown key"):
+        faults.parse_plan(str(unknown_key))
+
+
+# -- site firing -------------------------------------------------------
+
+
+def test_fire_hits_exact_window():
+    faults.install(faults.parse_plan("data.read:ioerror:2:2"))
+    faults.fire("data.read")  # hit 1: before the window
+    faults.fire("data.read")  # hit 2: still before
+    for _ in range(2):        # hits 3-4: the (after_n, after_n+count]
+        with pytest.raises(faults.InjectedIOError):
+            faults.fire("data.read")
+    faults.fire("data.read")  # hit 5: past the window
+
+
+def test_fatal_kind_raises_fatal():
+    faults.install(faults.parse_plan("ckpt.save:fatal:0"))
+    with pytest.raises(faults.FatalFaultError):
+        faults.fire("ckpt.save")
+
+
+def test_injected_ioerror_is_oserror_and_transient():
+    assert issubclass(faults.InjectedIOError, OSError)
+    assert any(issubclass(faults.InjectedIOError, t)
+               for t in faults.TRANSIENT)
+
+
+def test_torn_kind_truncates_file_and_continues(tmp_path):
+    victim = tmp_path / "checkpoint-000.ckpt"
+    victim.write_bytes(b"x" * 1000)
+    faults.install(faults.parse_plan("ckpt.finalize:torn:0"))
+    faults.fire("ckpt.finalize", path=str(victim))  # must NOT raise
+    assert victim.stat().st_size == 500
+
+
+def test_path_match_filters_hits(tmp_path):
+    # The hit counter advances on EVERY targeted fire — path_match only
+    # filters which hits act — so the window must span both hits.
+    plan = faults.FaultPlan([faults.FaultSpec(
+        site="ckpt.finalize", kind="ioerror", path_match="best",
+        count=2)])
+    faults.install(plan)
+    faults.fire("ckpt.finalize", path=str(tmp_path / "checkpoint-0"))
+    with pytest.raises(faults.InjectedIOError):
+        faults.fire("ckpt.finalize", path=str(tmp_path / "bestmodel"))
+
+
+# -- zero-cost when disabled ------------------------------------------
+
+
+def test_no_plan_is_a_noop():
+    assert faults.installed() is None
+    assert not faults.targets("data.read")
+    for site in faults.SITES:  # one None check per call, nothing else
+        faults.fire(site)
+
+
+# -- retry policy ------------------------------------------------------
+
+
+def test_retry_schedule_is_deterministic():
+    p = faults.RetryPolicy(seed=5)
+    a = [p._delay("data.read", k) for k in (1, 2, 3)]
+    b = [p._delay("data.read", k) for k in (1, 2, 3)]
+    assert a == b
+    # exponential envelope with jitter in [0.5, 1.0] of the backoff
+    for k, d in enumerate(a, start=1):
+        backoff = min(p.max_delay_s, p.base_delay_s * 2.0 ** (k - 1))
+        assert 0.5 * backoff <= d <= backoff
+    # different sites / seeds jitter differently
+    assert p._delay("ckpt.save", 1) != a[0]
+    assert faults.RetryPolicy(seed=6)._delay("data.read", 1) != a[0]
+
+
+def test_retry_recovers_after_transients(tmp_path):
+    telemetry._active = telemetry.Telemetry(
+        enabled=True, rsl_path=str(tmp_path), rank=0)
+    faults.install(faults.parse_plan("data.read:ioerror:0:2"))
+    calls = []
+
+    def read():
+        faults.fire("data.read")
+        calls.append(1)
+        return "payload"
+
+    p = faults.RetryPolicy(base_delay_s=0.001, seed=0)
+    assert p.call(read, "data.read") == "payload"
+    assert len(calls) == 1  # two injected failures, third attempt wins
+    tel = telemetry.get()
+    assert tel.counter("retry/attempts").value == 2
+    assert tel.counter("retry/giveups").value == 0
+
+
+def test_retry_gives_up_after_max_attempts(tmp_path):
+    telemetry._active = telemetry.Telemetry(
+        enabled=True, rsl_path=str(tmp_path), rank=0)
+
+    def always_fails():
+        raise TimeoutError("unreachable")
+
+    p = faults.RetryPolicy(max_attempts=3, base_delay_s=0.001)
+    with pytest.raises(TimeoutError):
+        p.call(always_fails, "runtime.init")
+    tel = telemetry.get()
+    assert tel.counter("retry/attempts").value == 2  # retries, not tries
+    assert tel.counter("retry/giveups").value == 1
+
+
+def test_fatal_and_nontransient_never_retried():
+    attempts = []
+
+    def fatal():
+        attempts.append(1)
+        raise faults.FatalFaultError("injected")
+
+    p = faults.RetryPolicy(base_delay_s=0.001)
+    with pytest.raises(faults.FatalFaultError):
+        p.call(fatal, "ckpt.save")
+    assert len(attempts) == 1  # attempt 1 included: no retry on fatal
+
+    def missing():
+        attempts.append(1)
+        raise FileNotFoundError("no such checkpoint")
+
+    with pytest.raises(FileNotFoundError):
+        # narrowed transient tuple: FileNotFoundError is a plain OSError
+        # but the caller classifies it fatal (retrying cannot help)
+        p.call(missing, "ckpt.restore",
+               transient=(PermissionError, TimeoutError))
+    assert len(attempts) == 2
+
+
+def test_retry_deadline_stops_further_attempts():
+    p = faults.RetryPolicy(max_attempts=100, base_delay_s=0.001,
+                           timeout_s=0.0)
+    attempts = []
+
+    def fails():
+        attempts.append(1)
+        raise TimeoutError("slow")
+
+    with pytest.raises(TimeoutError):
+        p.call(fails, "data.read")
+    assert len(attempts) == 1  # deadline already passed after attempt 1
+
+
+def test_configure_installs_plan_and_policy(tmp_path):
+    faults.configure("data.read:ioerror:0", fault_seed=9,
+                     retry_max_attempts=5, retry_base_delay_s=0.01,
+                     retry_timeout_s=1.5)
+    assert faults.targets("data.read")
+    p = faults.policy()
+    assert (p.max_attempts, p.base_delay_s, p.timeout_s, p.seed) \
+        == (5, 0.01, 1.5, 9)
+    faults.configure(None)  # re-invocation clears the plan
+    assert faults.installed() is None

@@ -1,0 +1,52 @@
+"""utils.GracefulShutdown unit semantics (ISSUE 5 satellite): the first
+signal only sets the flag, a SECOND signal escalates to the previous
+handler (a hung dispatch stays abortable), and construction off the
+main thread is a clean no-op (Python restricts signal handlers to the
+main thread)."""
+
+import signal
+import threading
+
+from distributedpytorch_tpu import utils
+
+
+def test_first_signal_sets_flag_and_run_continues():
+    with utils.GracefulShutdown() as gs:
+        assert not gs.requested
+        signal.raise_signal(signal.SIGTERM)
+        assert gs.requested  # flag only — no exception, no exit
+    # context exit restored the previous handler
+    assert signal.getsignal(signal.SIGTERM) is signal.SIG_DFL
+
+
+def test_second_signal_escalates_to_previous_handler():
+    hits = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: hits.append(s))
+    try:
+        with utils.GracefulShutdown() as gs:
+            signal.raise_signal(signal.SIGTERM)
+            assert gs.requested and hits == []
+            # second signal: restore the pre-context handler and
+            # re-raise through it — a force-abort, not another flag set
+            signal.raise_signal(signal.SIGTERM)
+            assert hits == [signal.SIGTERM]
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_non_main_thread_is_noop():
+    before = signal.getsignal(signal.SIGTERM)
+    result = {}
+
+    def enter():
+        with utils.GracefulShutdown() as gs:
+            result["requested"] = gs.requested
+            result["handler"] = signal.getsignal(signal.SIGTERM)
+
+    t = threading.Thread(target=enter)
+    t.start()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert result["requested"] is False
+    assert result["handler"] is before  # never touched the handlers
+    assert signal.getsignal(signal.SIGTERM) is before

@@ -469,6 +469,75 @@ def test_bare_except_rationale_comment_negative(tmp_path):
     assert _lint(tmp_path, {"mod.py": src}, rule="bare-except") == []
 
 
+# -- rule 9: retry-without-backoff ------------------------------------
+
+def test_retry_without_backoff_positive(tmp_path):
+    src = """
+        def fetch(read):
+            while True:           # hot-spin: hammers the failing read
+                try:
+                    return read()
+                except OSError:
+                    continue
+
+        def fetch_counted(read, max_attempts):
+            for attempt in range(max_attempts):
+                try:
+                    return read()
+                except OSError:
+                    pass
+    """
+    found = _lint(tmp_path, {"mod.py": src},
+                  rule="retry-without-backoff")
+    assert len(found) == 2
+    assert all("backoff" in f.message for f in found)
+
+
+def test_retry_without_backoff_negative(tmp_path):
+    src = """
+        import time
+        from distributedpytorch_tpu import faults
+
+        def paced(read):          # sleeps between attempts: fine
+            while True:
+                try:
+                    return read()
+                except OSError:
+                    time.sleep(0.1)
+
+        def policied(read):       # delegated pacing: fine
+            return faults.retry(read, site="data.read")
+
+        def bounded(q, item, stop):
+            while not stop():     # the timeout IS the pacing: fine
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except Exception:  # queue.Full in real code
+                    pass
+            return False
+
+        def drain(queue, host_iter):
+            while queue:          # iterator control flow, not a retry
+                yield queue.popleft()
+                try:
+                    queue.append(next(host_iter))
+                except StopIteration:
+                    pass
+
+        def per_item(paths):      # skip-bad-item for loop: not a retry
+            out = []
+            for p in paths:
+                try:
+                    out.append(open(p).read())
+                except OSError:
+                    continue
+            return out
+    """
+    assert _lint(tmp_path, {"mod.py": src},
+                 rule="retry-without-backoff") == []
+
+
 # -- suppressions ------------------------------------------------------
 
 def test_suppression_with_rationale_silences(tmp_path):

@@ -276,3 +276,44 @@ def test_telemetry_cli_flag_and_subcommand_roundtrip():
     assert not config_from_argv(["train", "-d", "/x"]).telemetry
     rep = config_from_argv(["telemetry", "--rsl_path", "/some/dir"])
     assert rep.action == "telemetry" and rep.rsl_path == "/some/dir"
+
+
+# -- writer I/O failure never kills training (ISSUE 5 satellite) -------
+
+
+def test_write_error_disables_sink_and_counts(tmp_path, restore_global):
+    from distributedpytorch_tpu import faults
+
+    tel = telemetry.Telemetry(enabled=True, rsl_path=str(tmp_path),
+                              rank=0)
+    # One injected I/O error at the first flush: the write must be
+    # swallowed (training would continue), counted, and the sink killed.
+    faults.install(faults.parse_plan("telemetry.write:ioerror:0"))
+    try:
+        tel.event("before_failure")
+        tel.flush()  # fails — must NOT raise
+        assert tel.write_errors == 1 and tel._sink_dead
+        tel.event("after_failure")
+        tel.flush()  # dead sink: drops silently, still no raise
+        assert tel.write_errors == 1
+    finally:
+        faults.install(None)
+    # close() retries once (the condition may have cleared) so the
+    # write_errors counter reaches the file for the report to see.
+    tel.close()
+    events = _read_events(tmp_path / "telemetry" / "rank0.jsonl")
+    by_name = {e["name"]: e for e in events if e["kind"] == "counter"}
+    assert by_name["telemetry/write_errors"]["value"] == 1.0
+
+
+def test_report_warns_on_write_errors_and_skipped_ranks():
+    agg = telemetry.aggregate([
+        {"kind": "event", "name": "run_start", "rank": 0, "ts": 1.0,
+         "attrs": {"processes": 2}},
+        {"kind": "counter", "name": "telemetry/write_errors", "rank": 0,
+         "ts": 2.0, "value": 3.0},
+    ])
+    report = telemetry.render_report(agg)
+    assert "WARNING: 3 telemetry write error(s)" in report
+    # 2 processes ran, only rank 0's file was readable
+    assert "rank(s) [1] skipped" in report
