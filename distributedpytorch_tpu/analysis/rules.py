@@ -1,4 +1,4 @@
-"""The graftlint rule catalog — eight framework-specific AST rules.
+"""The graftlint rule catalog — framework-specific AST rules.
 
 Each rule is an object with ``name``, ``description`` and
 ``check(project) -> Iterator[Finding]``.  Rules are deliberately
@@ -987,6 +987,73 @@ class RetryWithoutBackoff(Rule):
                                 "attempt with timeout=")
 
 
+class ProfilerTraceLeak(Rule):
+    """``jax.profiler.start_trace`` begins a GLOBAL capture; a path that
+    raises (or simply returns) before the matching ``stop_trace`` leaves
+    the profiler running for the rest of the process — every later step
+    is traced into an ever-growing buffer, and a later ``start_trace``
+    (the next anomaly capture, a --profile run) dies on "already
+    started".  The stop must be reachable on every path: either a
+    ``stop_trace`` inside a ``finally`` in the same function, or — for
+    the split start/stop state-machine shape (flightrec.AnomalyDetector
+    starts in one method, stops K steps later in another) — a method of
+    the same class whose ``finally`` stops it, so the object's close()
+    path is the guarantee.  The ``with jax.profiler.trace(...):``
+    context manager is always safe (it never parses as start_trace)."""
+
+    name = "profiler-trace-leak"
+    description = ("jax.profiler.start_trace without a stop_trace in a "
+                   "finally (same function or a method of the same "
+                   "class)")
+
+    def _stops_in_finally(self, scope: ast.AST) -> bool:
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Try):
+                for stmt in node.finalbody:
+                    for call in walk_calls(stmt):
+                        if last_seg(call_name(call)) == "stop_trace":
+                            return True
+        return False
+
+    def _starts(self, node: ast.AST, fn, cls, out: List[Tuple]) -> None:
+        """Every start_trace call with its enclosing function/class."""
+        for child in ast.iter_child_nodes(node):
+            nfn, ncls = fn, cls
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nfn = child
+            elif isinstance(child, ast.ClassDef):
+                ncls, nfn = child, None
+            elif isinstance(child, ast.Call) \
+                    and last_seg(call_name(child)) == "start_trace":
+                out.append((child, fn, cls))
+            self._starts(child, nfn, ncls, out)
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            starts: List[Tuple] = []
+            self._starts(mod.tree, None, None, starts)
+            for call, fn, cls in starts:
+                scope = fn if fn is not None else mod.tree
+                if self._stops_in_finally(scope):
+                    continue
+                if cls is not None and any(
+                        self._stops_in_finally(meth)
+                        for meth in cls.body
+                        if isinstance(meth, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))
+                        and meth is not fn):
+                    continue
+                where = (f"function {fn.name!r}" if fn is not None
+                         else "module scope")
+                yield self.finding(
+                    mod, call.lineno,
+                    f"start_trace in {where} has no stop_trace in a "
+                    f"finally on the same function (or a method of the "
+                    f"same class): an exception leaks a running "
+                    f"profiler — wrap the traced region in "
+                    f"try/finally: jax.profiler.stop_trace()")
+
+
 RULES = (
     HostSyncInStepLoop(),
     TraceImpurity(),
@@ -997,6 +1064,7 @@ RULES = (
     ConfigDrift(),
     BareExcept(),
     RetryWithoutBackoff(),
+    ProfilerTraceLeak(),
 )
 
 RULES_BY_NAME = {r.name: r for r in RULES}

@@ -20,7 +20,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import checkpoint as ckpt
-from . import faults, parallel, runtime, telemetry, utils
+from . import costs, faults, flightrec, parallel, runtime, telemetry, utils
 from .config import Config, config_from_argv
 from .data import augment  # noqa: F401  (re-exported for drivers/tests)
 from .data.datasets import Dataset, Split, load_dataset
@@ -201,6 +201,10 @@ def _aot_warmup(cfg: Config, engine: Engine, state, train_loader,
     The compiled executables are NOT kept: the warmup's value is filling
     the persistent cache (and XLA's backend caches) so the training
     loop's own jit dispatch compiles from cache, not from scratch.
+    Before each one is dropped, its ``cost_analysis()`` FLOPs/bytes are
+    recorded into the shared cost registry (costs.py) and saved to
+    ``RSL_PATH/costs.json`` — MFU math and profile_breakdown read the
+    same provenance-stamped numbers the warmup measured.
     """
     tel = telemetry.get()
     hits_before = runtime.compilation_cache_hits()
@@ -234,32 +238,44 @@ def _aot_warmup(cfg: Config, engine: Engine, state, train_loader,
         idx_va, valid_va = plan(valid_loader, stacked=k)
         keys = jnp.stack([utils.fold_key(root, start_epoch + i)
                           for i in range(k)])
-        engine.train_epochs.lower(
+        costs.record("train_epochs", engine.train_epochs.lower(
             state, train_loader.images, train_loader.labels, idx_tr,
             valid_tr, valid_loader.images, valid_loader.labels,
-            idx_va, valid_va, keys).compile()
+            idx_va, valid_va, keys).compile())
     else:
         if isinstance(train_loader, ResidentLoader):
             idx_tr, valid_tr = plan(train_loader)
-            engine.train_epoch.lower(
+            costs.record("train_epoch", engine.train_epoch.lower(
                 state, train_loader.images, train_loader.labels, idx_tr,
-                valid_tr, key).compile()
+                valid_tr, key).compile())
         else:
             img, lbl, vld = batch(train_loader)
-            engine.train_step.lower(state, img, lbl, vld, key).compile()
+            costs.record("train_step", engine.train_step.lower(
+                state, img, lbl, vld, key).compile())
         if isinstance(valid_loader, ResidentLoader):
             idx_va, valid_va = plan(valid_loader)
-            engine.eval_epoch.lower(
+            costs.record("eval_epoch", engine.eval_epoch.lower(
                 state, valid_loader.images, valid_loader.labels, idx_va,
-                valid_va).compile()
+                valid_va).compile())
         else:
             img, lbl, vld = batch(valid_loader)
-            engine.eval_step.lower(state, img, lbl, vld).compile()
+            costs.record("eval_step", engine.eval_step.lower(
+                state, img, lbl, vld).compile())
     warmup_s = time.perf_counter() - t0
     hit = runtime.compilation_cache_hits() > hits_before
     tel.gauge("compile/warmup_s").set(warmup_s)
     tel.gauge("compile/cache_hit").set(1.0 if hit else 0.0)
+    # Register the analytic per-sample count beside the XLA estimates so
+    # both methodologies live in one costs.json, distinguishable by
+    # ``source`` — and only the main process writes the shared file.
+    fps = getattr(engine, "_flops_per_sample", None)
+    if fps:
+        costs.record_analytic("train_flops_per_sample",
+                              flops_per_sample=fps,
+                              note="engine jaxpr count (ops.flops); "
+                                   "x global_batch for per-step")
     if runtime.is_main():
+        costs.save(cfg.rsl_path)
         logging.info(f"AOT warmup: train/eval programs compiled in "
                      f"{warmup_s:.2f}s "
                      f"({'persistent-cache hit' if hit else 'cold'})")
@@ -337,18 +353,28 @@ def _run_train_pass(engine: Engine, state, loader, epoch: int, key
     # (enqueue, not device completion — dispatch is async; the epoch-end
     # device_get absorbs the backlog).  Complementary to the pipeline's
     # data/wait_s counters: together they split host time into data wait
-    # vs step dispatch.  Gated on tel.enabled so the off path runs the
-    # original loop with zero added per-step work.
+    # vs step dispatch.  The flight recorder (on by default) additionally
+    # keeps the last N steps' total/wait/dispatch times + queue depth in
+    # its ring, and drives the anomaly detector when --anomaly-capture is
+    # set.  With BOTH disabled the off path runs the original loop with
+    # zero added per-step work.
+    rec = flightrec.get()
+    instrument = tel.enabled or rec.enabled
     step_hist = tel.histogram("step/dispatch_s") if tel.enabled else None
+    depth_fn = getattr(loader, "lookahead_depth", None)
     loss_hist, correct_hist, valid_hist = [], [], []
+    prev_end = time.perf_counter() if instrument else 0.0
+    dispatch_s = 0.0
     for i, (images, labels, valid) in enumerate(loader.epoch(epoch)):
-        if step_hist is not None:
+        if instrument:
             t0 = time.perf_counter()
             with jax.profiler.StepTraceAnnotation(
                     "train_step", step_num=epoch * nb_iters + i):
                 state, metrics = engine.train_step(state, images, labels,
                                                    valid, key)
-            step_hist.observe(time.perf_counter() - t0)
+            dispatch_s = time.perf_counter() - t0
+            if step_hist is not None:
+                step_hist.observe(dispatch_s)
         else:
             state, metrics = engine.train_step(state, images, labels,
                                                valid, key)
@@ -357,6 +383,17 @@ def _run_train_pass(engine: Engine, state, loader, epoch: int, key
         valid_hist.append(metrics["valid"])
         if runtime.is_main():
             print(f"\r{epoch:03d} {i / nb_iters * 100:.0f}%", end="\r")
+        if instrument:
+            end = time.perf_counter()
+            # step_s spans yield-to-yield (wait + dispatch + host book-
+            # keeping): the quantity the anomaly detector judges, since
+            # a straggler can hide in any slice of it.
+            flightrec.observe_step(
+                rec, epoch=epoch, step=i, step_s=end - prev_end,
+                dispatch_s=dispatch_s, wait_s=t0 - prev_end,
+                queue_depth=(depth_fn(epoch) if depth_fn is not None
+                             else None))
+            prev_end = end
     with runtime.sanctioned_host_transfer():  # ONE sync per epoch
         losses, corrects, valids = jax.device_get(
             jnp.stack([jnp.stack(loss_hist), jnp.stack(correct_hist),
@@ -498,6 +535,22 @@ def run_train(cfg: Config) -> dict:
     # After distributed init so the rank in the filename is the GLOBAL
     # process index (per-rank files are the multi-host contract).
     tel = telemetry.configure(cfg.rsl_path, cfg.telemetry)
+    # Flight recorder + (opt-in) anomaly-triggered profiling: the ring
+    # buffer is on by default — the black box must be recording BEFORE
+    # anything goes wrong (flightrec.py).
+    rec = flightrec.configure(cfg.rsl_path, cfg.flightrec,
+                              rank=runtime.process_index(),
+                              ring_size=cfg.flightrec_ring)
+    if cfg.anomaly_capture:
+        flightrec.attach_detector(
+            rec,
+            trace_dir=os.path.join(cfg.rsl_path, "anomaly_traces"),
+            window=cfg.anomaly_window, mad_k=cfg.anomaly_mad_k,
+            rel_factor=cfg.anomaly_rel_factor,
+            min_excess_s=cfg.anomaly_min_excess,
+            capture_steps=cfg.anomaly_capture_steps,
+            max_captures=cfg.anomaly_max_captures)
+    costs.reset()
     # Before the first jit compile, so every program of this run can be
     # served from / written to the persistent cache.
     runtime.configure_compilation_cache(cfg.compilation_cache_path())
@@ -729,6 +782,11 @@ def run_train(cfg: Config) -> dict:
             if saver is not None:
                 saver.close()
         finally:
+            # Flight-record dump BEFORE the telemetry close so a crash
+            # leaves both trails; sys.exc_info distinguishes the crash
+            # dump from the ordinary end-of-run one.
+            flightrec.get().close(
+                "crash" if sys.exc_info()[0] is not None else "run_end")
             tel.close()
             runtime.reset_compilation_cache()
 
@@ -744,12 +802,20 @@ def _health_boundary(tel, shutdown, epoch: int, err) -> bool:
     tel.flush()  # boundary: buffered events hit the disk
     any_failed, any_shutdown = runtime.agree_health(
         err is not None, shutdown.requested)
+    # The allgather above returns at (nearly) the same real instant on
+    # every rank, so this event's paired ts+mono stamps are the timeline
+    # merger's cross-rank clock-alignment points (timeline.py).
+    tel.event("health_boundary", epoch=epoch)
     if any_failed:
         # Loud on EVERY rank: each process's JSONL records who noticed
         # and why before the coordinated exit — never a silent death.
         tel.event("peer_failure", epoch=epoch, local=err is not None,
                   error=repr(err) if err is not None else None)
         tel.flush()
+        # The healthy ranks' black box is the post-mortem: what were the
+        # minutes before the peer died doing?  Dump it now, before the
+        # coordinated exit unwinds.
+        flightrec.get().dump("peer_failure")
         if err is not None:
             raise err
         raise faults.PeerFailureError(
@@ -782,25 +848,28 @@ def _run_train_epochs(cfg: Config, engine: Engine, state, train_loader,
         epoch_err = None
         try:
             # SURVEY §5 tracing: trace the first post-compile epoch.
+            # stop_trace lives in the finally: an epoch that raises must
+            # not leak a running profiler into the next epoch's
+            # start_trace (graftlint profiler-trace-leak).
             tracing = cfg.profile and epoch == start_epoch + 1
             if tracing:
                 jax.profiler.start_trace(f"{cfg.rsl_path}/trace")
-
-            epoch_key = utils.fold_key(root, epoch)
-            with tel.span("epoch", epoch=epoch):
-                with tel.span("train_pass", epoch=epoch,
-                              steps=len(train_loader)):
-                    state, train_loss, train_acc = _run_train_pass(
-                        engine, state, train_loader, epoch, epoch_key)
-                train_end = utils.monotonic()
-                valid_loss, valid_acc = _run_eval_pass(
-                    engine, state, valid_loader, epoch)
-
-            if tracing:
-                jax.profiler.stop_trace()
-                if runtime.is_main():
-                    logging.info(f"profiler trace written to "
-                                 f"{cfg.rsl_path}/trace")
+            try:
+                epoch_key = utils.fold_key(root, epoch)
+                with tel.span("epoch", epoch=epoch):
+                    with tel.span("train_pass", epoch=epoch,
+                                  steps=len(train_loader)):
+                        state, train_loss, train_acc = _run_train_pass(
+                            engine, state, train_loader, epoch, epoch_key)
+                    train_end = utils.monotonic()
+                    valid_loss, valid_acc = _run_eval_pass(
+                        engine, state, valid_loader, epoch)
+            finally:
+                if tracing:
+                    jax.profiler.stop_trace()
+                    if runtime.is_main():
+                        logging.info(f"profiler trace written to "
+                                     f"{cfg.rsl_path}/trace")
 
             end = utils.monotonic()
             epoch_mins, epoch_secs = utils.get_duration(epoch_start, end)
@@ -888,6 +957,9 @@ def run_test(cfg: Config) -> dict:
     utils.initialize_logging(cfg.rsl_path, cfg.log_file,
                              truncate=runtime.is_main())
     tel = telemetry.configure(cfg.rsl_path, cfg.telemetry)
+    flightrec.configure(cfg.rsl_path, cfg.flightrec,
+                        rank=runtime.process_index(),
+                        ring_size=cfg.flightrec_ring)
     runtime.configure_compilation_cache(cfg.compilation_cache_path())
     mesh = runtime.make_mesh(model_parallel=cfg.model_parallel,
                              seq_parallel=cfg.seq_parallel)
@@ -922,6 +994,8 @@ def run_test(cfg: Config) -> dict:
     try:
         loss, acc = _run_eval_pass(engine, state, test_loader, epoch=0)
     finally:
+        flightrec.get().close(
+            "crash" if sys.exc_info()[0] is not None else "run_end")
         tel.close()
         runtime.reset_compilation_cache()
     mins, secs = utils.get_duration(start_time, utils.monotonic())
@@ -939,6 +1013,17 @@ def main(argv=None) -> int:
 
         return lint_cli(json_output=cfg.lint_json,
                         paths=cfg.lint_paths or None)
+    if cfg.action == "timeline":
+        # Offline merge of per-rank JSONL + flight records into a Chrome
+        # trace-event file (Perfetto-loadable) — no JAX backend touched.
+        from . import timeline
+
+        try:
+            print(timeline.run_cli(cfg.rsl_path, out=cfg.timeline_out))
+        except ValueError as e:
+            logging.error(f"{e}, exiting...")
+            return 1
+        return 0
     if cfg.action == "telemetry":
         # Offline aggregation of RSL_PATH/telemetry/rank*.jsonl — no
         # training banners, no JAX backend touched.

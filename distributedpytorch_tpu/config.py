@@ -193,6 +193,30 @@ class Config:
     # and an optional focused path list (empty = the full repo scope).
     lint_json: bool = False
     lint_paths: tuple = ()
+    # Flight recorder (flightrec.py, ISSUE 7): a fixed-memory per-rank
+    # ring buffer of per-step records (step/dispatch/data-wait times,
+    # queue depth, retry/fault events) dumped to
+    # RSL_PATH/flightrec-rank<N>.json on crash/preempt/peer-failure and
+    # at run end.  ON by default — the black box is only useful if it
+    # was recording when things went wrong; the per-step cost is a
+    # bounded deque append (budgeted by scripts/anomaly_gate.py).
+    flightrec: bool = True
+    flightrec_ring: int = 4096
+    # Anomaly-triggered profiling: watch per-step time with a rolling
+    # median/MAD window (+ starvation and retry-burst triggers) and fire
+    # a bounded number of programmatic jax.profiler captures of the next
+    # K steps into RSL_PATH/anomaly_traces/.  Opt-in; requires the
+    # flight recorder (the capture is explained by its records).
+    anomaly_capture: bool = False
+    anomaly_window: int = 32               # rolling baseline, steps
+    anomaly_mad_k: float = 8.0             # excess > mad_k * MAD ...
+    anomaly_rel_factor: float = 3.0        # ... AND step > rel * median
+    anomaly_min_excess: float = 0.05       # absolute excess floor, sec
+    anomaly_capture_steps: int = 4         # K steps per capture
+    anomaly_max_captures: int = 2          # per-run capture budget
+    # 'timeline' subcommand: merged Chrome trace-event output path
+    # (default RSL_PATH/timeline.json).
+    timeline_out: Optional[str] = None
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -263,12 +287,13 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                         "gauges)")
     p.add_argument("--fault-plan", type=str, default=None,
                    dest="faultPlan", metavar="PLAN",
-                   help="fault-injection plan: 'site:kind:after_n[:count]' "
+                   help="fault-injection plan: "
+                        "'site:kind:after_n[:count[:stall_s]]' "
                         "(';'-separated, e.g. 'data.read:ioerror:2') or a "
                         "JSON plan file; sites: data.read data.host_batch "
                         "ckpt.save ckpt.finalize ckpt.restore runtime.init "
                         "telemetry.write; kinds: ioerror fatal preempt "
-                        "torn (default: no faults, zero overhead)")
+                        "torn stall (default: no faults, zero overhead)")
     p.add_argument("--fault-seed", type=int, default=0, dest="faultSeed",
                    metavar="S",
                    help="seed for the fault plan + deterministic retry "
@@ -320,6 +345,51 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                         "throughput + MFU) to RSL_PATH/telemetry/"
                         "rank<N>.jsonl; summarize with "
                         "'main.py telemetry --rsl_path DIR'")
+    p.add_argument("--flightrec", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="per-rank ring-buffer flight recorder: per-step "
+                        "step/dispatch/data-wait timing + retry/fault "
+                        "events, dumped to RSL_PATH/flightrec-rank<N>"
+                        ".json on crash/preempt/peer-failure and at run "
+                        "end (default: on; --no-flightrec disables)")
+    p.add_argument("--flightrec-ring", type=int, default=4096,
+                   dest="flightrecRing", metavar="N",
+                   help="flight-recorder ring size: the last N step/"
+                        "event records are kept (fixed memory; "
+                        "default 4096)")
+    p.add_argument("--anomaly-capture", action="store_true",
+                   dest="anomalyCapture",
+                   help="profile anomalies automatically: when a step "
+                        "goes anomalous (rolling median/MAD step-time "
+                        "outlier, data starvation, or a retry burst) "
+                        "capture the next K steps with jax.profiler "
+                        "into RSL_PATH/anomaly_traces/ and emit an "
+                        "'anomaly' telemetry event (requires the flight "
+                        "recorder)")
+    p.add_argument("--anomaly-window", type=int, default=32,
+                   dest="anomalyWindow", metavar="W",
+                   help="anomaly baseline: rolling window of the last W "
+                        "step times (no judgments until full; "
+                        "default 32)")
+    p.add_argument("--anomaly-mad-k", type=float, default=8.0,
+                   dest="anomalyMadK", metavar="K",
+                   help="anomaly threshold: a step is anomalous when its "
+                        "excess over the window median exceeds K*MAD "
+                        "(and the absolute floor; default 8.0)")
+    p.add_argument("--anomaly-min-excess", type=float, default=0.05,
+                   dest="anomalyMinExcess", metavar="SEC",
+                   help="absolute floor on the step-time excess before "
+                        "an anomaly fires — keeps scheduler jitter on "
+                        "millisecond steps quiet (default 0.05)")
+    p.add_argument("--anomaly-capture-steps", type=int, default=4,
+                   dest="anomalyCaptureSteps", metavar="K",
+                   help="steps per anomaly-triggered profiler capture "
+                        "(default 4)")
+    p.add_argument("--anomaly-max-captures", type=int, default=2,
+                   dest="anomalyMaxCaptures", metavar="N",
+                   help="per-run budget of anomaly-triggered captures — "
+                        "a pathological run cannot fill the disk with "
+                        "traces (default 2)")
     p.add_argument("--epochs-per-dispatch", type=int, default=1,
                    dest="epochsPerDispatch", metavar="K",
                    help="fuse K train+valid epochs per XLA dispatch "
@@ -412,6 +482,21 @@ def build_parser() -> argparse.ArgumentParser:
                        help=f"run directory holding telemetry/ "
                             f"(default: {RSL_PATH})")
 
+    # Offline timeline merge — reads RSL_PATH/telemetry/rank*.jsonl +
+    # RSL_PATH/flightrec-rank*.json and writes Chrome trace-event JSON
+    # (open in Perfetto / chrome://tracing); needs no train/test flags.
+    p_tl = sub.add_parser(
+        "timeline", help="merge per-rank telemetry + flight records "
+                         "into a Perfetto-loadable Chrome trace, with "
+                         "cross-rank skew + straggler attribution")
+    p_tl.add_argument("--rsl_path", type=str, default=RSL_PATH,
+                      help=f"run directory holding telemetry/ and "
+                           f"flightrec dumps (default: {RSL_PATH})")
+    p_tl.add_argument("-o", "--out", type=str, default=None,
+                      metavar="FILE",
+                      help="trace output path (default: "
+                           "RSL_PATH/timeline.json)")
+
     # Static analysis (analysis/ graftlint) — no JAX backend touched.
     p_lint = sub.add_parser(
         "lint", help="run the graftlint static analysis pass "
@@ -427,6 +512,9 @@ def config_from_argv(argv=None) -> Config:
     args = build_parser().parse_args(argv)
     if args.action == "telemetry":
         return Config(action="telemetry", rsl_path=args.rsl_path)
+    if args.action == "timeline":
+        return Config(action="timeline", rsl_path=args.rsl_path,
+                      timeline_out=args.out)
     if args.action == "lint":
         return Config(action="lint", lint_json=args.json,
                       lint_paths=tuple(args.paths))
@@ -472,4 +560,12 @@ def config_from_argv(argv=None) -> Config:
         pipeline_parallel=args.pipelineParallel,
         pipeline_microbatches=args.pipelineMicrobatches,
         moe_experts=args.moeExperts,
+        flightrec=args.flightrec,
+        flightrec_ring=args.flightrecRing,
+        anomaly_capture=args.anomalyCapture,
+        anomaly_window=args.anomalyWindow,
+        anomaly_mad_k=args.anomalyMadK,
+        anomaly_min_excess=args.anomalyMinExcess,
+        anomaly_capture_steps=args.anomalyCaptureSteps,
+        anomaly_max_captures=args.anomalyMaxCaptures,
     )

@@ -1,0 +1,174 @@
+"""L2: one provenance-stamped cost registry for compiled programs.
+
+MFU gauges, ``scripts/profile_breakdown.py`` and ad-hoc roofline math all
+need "how many FLOPs does this program move per invocation" — and before
+this module each consumer derived the number its own way (analytic jaxpr
+counting here, XLA ``cost_analysis()`` there), so two reports could
+silently disagree about the same executable.  This registry is the single
+resting place:
+
+* ``record(name, compiled)`` — pull FLOPs / bytes-accessed out of an AOT
+  ``Compiled`` object's ``cost_analysis()`` (the XLA estimate for the
+  exact HLO that will run).  The AOT warmup (cli._aot_warmup) records
+  every program it compiles.
+* ``record_analytic(name, ...)`` — register a hand/jaxpr-derived count
+  (ops.flops) under the same roof, tagged ``source="analytic"`` so a
+  reader can always tell which methodology produced a number.
+* ``save(rsl_path)`` — persist the registry to ``RSL_PATH/costs.json``
+  with run-level provenance (device kind, jax version, wall/mono stamps),
+  where the telemetry report and profile_breakdown can load it instead of
+  re-deriving.
+
+Every ``record*`` also emits a ``cost_analysis`` telemetry event, so the
+per-rank JSONL carries the numbers even if the process dies before
+``save`` runs.  All entry points are advisory: a backend whose
+``cost_analysis`` raises (some CPU builds) degrades to ``flops=None``
+rather than failing the warmup.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import jax
+
+from . import telemetry
+
+_lock = threading.Lock()
+_registry: Dict[str, dict] = {}
+
+
+def reset() -> None:
+    """Drop all recorded entries (start of each run; tests)."""
+    with _lock:
+        _registry.clear()
+
+
+def _device_kind() -> Optional[str]:
+    try:
+        devs = jax.devices()
+        return devs[0].device_kind if devs else None
+    except Exception:
+        # provenance is best-effort: an uninitialized backend (unit
+        # tests constructing entries off-device) records kind=None
+        return None
+
+
+def _first_analysis(compiled: Any) -> Optional[dict]:
+    """``cost_analysis()`` returns a dict on current jax, a list of dicts
+    on older versions, and raises on some backends — normalise to one
+    dict or None."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        # cost_analysis is advisory and backend-dependent (raises
+        # NotImplemented/Internal on some builds) — record None, never
+        # fail the warmup that called us
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    return dict(ca) if isinstance(ca, dict) else None
+
+
+def _stamp(entry: dict) -> dict:
+    # Paired stamps, same contract as telemetry records.
+    entry["ts"] = time.time()
+    entry["mono"] = time.monotonic()
+    entry["device_kind"] = _device_kind()
+    entry["jax_version"] = jax.__version__
+    return entry
+
+
+def record(name: str, compiled: Any) -> dict:
+    """Register an AOT-compiled executable's XLA cost estimate.
+
+    ``flops``/``bytes_accessed`` are per *invocation* of the program (so
+    an epoch-fused program reports the whole epoch's FLOPs, a step
+    program one step's).  Missing metrics record as None — an explicit
+    "the backend would not say", never a silent zero.
+    """
+    ca = _first_analysis(compiled)
+
+    def _metric(key: str) -> Optional[float]:
+        if ca is None or key not in ca:
+            return None
+        try:
+            v = float(ca[key])
+        except (TypeError, ValueError):
+            return None
+        return v if v >= 0 else None  # XLA uses negatives for "unknown"
+
+    entry = _stamp({
+        "source": "xla_cost_analysis",
+        "flops": _metric("flops"),
+        "bytes_accessed": _metric("bytes accessed"),
+    })
+    with _lock:
+        _registry[name] = entry
+    telemetry.get().event("cost_analysis", program=name,
+                          source=entry["source"], flops=entry["flops"],
+                          bytes_accessed=entry["bytes_accessed"])
+    return entry
+
+
+def record_analytic(name: str, *, flops: Optional[float] = None,
+                    flops_per_sample: Optional[float] = None,
+                    note: Optional[str] = None) -> dict:
+    """Register an analytically-derived count (ops.flops / jaxpr walk)."""
+    entry = _stamp({
+        "source": "analytic",
+        "flops": float(flops) if flops is not None else None,
+        "flops_per_sample": (float(flops_per_sample)
+                             if flops_per_sample is not None else None),
+    })
+    if note:
+        entry["note"] = note
+    with _lock:
+        _registry[name] = entry
+    telemetry.get().event("cost_analysis", program=name,
+                          source=entry["source"], flops=entry["flops"],
+                          flops_per_sample=entry.get("flops_per_sample"))
+    return entry
+
+
+def registry() -> Dict[str, dict]:
+    """Snapshot copy of the current registry (program name -> entry)."""
+    with _lock:
+        return {k: dict(v) for k, v in _registry.items()}
+
+
+def save(rsl_path: str) -> Optional[str]:
+    """Write ``RSL_PATH/costs.json``; returns the path (None if empty).
+
+    One file per run directory — the caller gates on the main process so
+    multi-host runs don't race on the write (every host compiles the
+    same programs, so rank 0's numbers speak for all)."""
+    progs = registry()
+    if not progs:
+        return None
+    doc = {
+        "device_kind": _device_kind(),
+        "jax_version": jax.__version__,
+        "saved_at": {"ts": time.time(), "mono": time.monotonic()},
+        "programs": progs,
+    }
+    os.makedirs(rsl_path, exist_ok=True)
+    path = os.path.join(rsl_path, "costs.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def load(rsl_path: str) -> Optional[dict]:
+    """Read a saved ``costs.json`` back (None if absent/unreadable)."""
+    try:
+        with open(os.path.join(rsl_path, "costs.json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

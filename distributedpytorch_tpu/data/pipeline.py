@@ -191,6 +191,17 @@ class ShardedLoader:
         """The lookahead structure of a specific epoch() generator."""
         return self._queues.get(epoch)
 
+    def lookahead_depth(self, epoch: int):
+        """Total buffered lookahead of an epoch's queue structure (None
+        before its first prefetching iteration) — the flight recorder's
+        per-step queue-depth sample (flightrec.py)."""
+        q = self._queues.get(epoch)
+        if q is None:
+            return None
+        if isinstance(q, list):  # threaded path: per-producer queues
+            return sum(x.qsize() for x in q)
+        return len(q)  # synchronous path: one deque
+
     def __len__(self) -> int:
         return self.batches_per_epoch
 
@@ -254,8 +265,10 @@ class ShardedLoader:
         ``data/starved_steps`` (consumer found no lookahead in the
         queue: H2D could not overlap that step), and
         ``data/queue_depth_sum`` (divide by batches for mean depth).
-        The disabled path is the original loop, untouched — no clock
-        reads, no counter lookups per step.
+        Every per-step wait is also observed into the ``data/wait_s``
+        HISTOGRAM, so the report prints p50/p95/p99 wait latencies next
+        to the totals.  The disabled path is the original loop,
+        untouched — no clock reads, no counter lookups per step.
         """
         tel = telemetry.get()
         if self.producer_threads > 0:
@@ -268,6 +281,7 @@ class ShardedLoader:
                     yield self._to_device(arrays)
                 return
             wait = tel.counter("data/wait_s")
+            wait_hist = tel.histogram("data/wait_s")
             batches = tel.counter("data/batches")
             while True:
                 t0 = time.perf_counter()
@@ -275,7 +289,9 @@ class ShardedLoader:
                     arrays = self._to_device(next(host_iter))
                 except StopIteration:
                     return
-                wait.add(time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                wait.add(dt)
+                wait_hist.observe(dt)
                 batches.add(1)
                 yield arrays
         # Registered (not just a local) so tests/bench can assert the
@@ -298,6 +314,7 @@ class ShardedLoader:
                     pass
             return
         wait = tel.counter("data/wait_s")
+        wait_hist = tel.histogram("data/wait_s")
         batches = tel.counter("data/batches")
         starved = tel.counter("data/starved_steps")
         depth_sum = tel.counter("data/queue_depth_sum")
@@ -326,7 +343,9 @@ class ShardedLoader:
                 queue.append(self._to_device(next(host_iter)))
             except StopIteration:
                 exhausted = True
-            wait.add(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            wait.add(dt)
+            wait_hist.observe(dt)
 
     def _threaded_epoch(self, epoch: int, tel):
         """Background-producer iterator: host gather + device_put dispatch
@@ -386,6 +405,7 @@ class ShardedLoader:
         enabled = tel.enabled
         if enabled:
             wait = tel.counter("data/wait_s")
+            wait_hist = tel.histogram("data/wait_s")
             batches = tel.counter("data/batches")
             starved = tel.counter("data/starved_steps")
             depth_sum = tel.counter("data/queue_depth_sum")
@@ -398,7 +418,9 @@ class ShardedLoader:
                         starved.add(1)
                     t0 = time.perf_counter()
                     item = q.get()
-                    wait.add(time.perf_counter() - t0)
+                    dt = time.perf_counter() - t0
+                    wait.add(dt)
+                    wait_hist.observe(dt)
                     batches.add(1)
                 else:
                     item = q.get()

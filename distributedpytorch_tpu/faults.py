@@ -17,14 +17,15 @@ named sites threaded through the runtime.  Sites currently wired:
 
 Plan forms (``--fault-plan``):
 
-  DSL string   "site:kind:after_n[:count]" — ';'-separated for multiple
-               specs; fires on the (after_n+1)-th .. (after_n+count)-th
-               hit of the site (count defaults to 1).
+  DSL string   "site:kind:after_n[:count[:stall_s]]" — ';'-separated for
+               multiple specs; fires on the (after_n+1)-th ..
+               (after_n+count)-th hit of the site (count defaults to 1;
+               stall_s only applies to kind=stall).
   JSON file    path to {"seed": S, "faults": [{"site": ..., "kind": ...,
-               "after_n": N, "count": C, "rank": R, "path_match": "sub"}
-               , ...]} — rank restricts a spec to one process,
-               path_match to fire() calls whose path contains the
-               substring.
+               "after_n": N, "count": C, "rank": R, "path_match": "sub",
+               "stall_s": T}, ...]} — rank restricts a spec to one
+               process, path_match to fire() calls whose path contains
+               the substring.
 
 Kinds: ``ioerror`` (raise InjectedIOError — an OSError, i.e. transient
 under the default retry classification), ``fatal`` (raise
@@ -32,10 +33,15 @@ FatalFaultError — never retried; drives the multi-host failure
 agreement), ``preempt`` (SIGTERM to self — deterministic mid-run
 preemption), ``torn`` (truncate the file/meta at the ``path`` the site
 passed — simulates a torn write discovered at the next load; only
-meaningful at ckpt.finalize).
+meaningful at ckpt.finalize), ``stall`` (sleep ``stall_s`` seconds at
+the site and carry on — a deterministic straggler/slow-I/O injection;
+this is how the flight recorder's anomaly trigger path is proven:
+one stalled step must produce exactly one profiler capture, see
+scripts/anomaly_gate.py).
 
-Every firing emits a ``fault_injected`` telemetry event, so chaos runs
-are auditable from the JSONL alone.  Zero-cost when disabled: with no
+Every firing emits a ``fault_injected`` telemetry event and a flight-
+recorder event (flightrec.py), so chaos runs are auditable from the
+JSONL alone and fault timing lands on the step timeline.  Zero-cost when disabled: with no
 plan installed ``fire()`` is one global load + None check, and the
 producer hot path doesn't even pay that — pipeline.py wraps its
 per-step host work only when ``targets(site)`` is true at epoch setup.
@@ -65,11 +71,11 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple, TypeVar
 
-from . import telemetry
+from . import flightrec, telemetry
 
 T = TypeVar("T")
 
-KINDS = ("ioerror", "fatal", "preempt", "torn")
+KINDS = ("ioerror", "fatal", "preempt", "torn", "stall")
 
 SITES = ("data.read", "data.host_batch", "ckpt.save", "ckpt.finalize",
          "ckpt.restore", "runtime.init", "telemetry.write")
@@ -104,6 +110,7 @@ class FaultSpec:
     count: int = 1
     rank: Optional[int] = None
     path_match: Optional[str] = None
+    stall_s: float = 0.25  # kind=stall only: injected sleep seconds
 
     def __post_init__(self):
         if self.site not in SITES:
@@ -116,6 +123,10 @@ class FaultSpec:
             raise ValueError(
                 f"fault {self.site}:{self.kind}: after_n must be >= 0 "
                 f"and count >= 1 (got {self.after_n}, {self.count})")
+        if self.stall_s <= 0:
+            raise ValueError(
+                f"fault {self.site}:{self.kind}: stall_s must be > 0 "
+                f"(got {self.stall_s})")
 
 
 class FaultPlan:
@@ -180,9 +191,19 @@ class FaultPlan:
         tel = telemetry.get()
         tel.event("fault_injected", site=spec.site, kind=spec.kind,
                   hit=hit, **({"path": path} if path else {}))
+        # "fault_kind", not "kind": flightrec reserves "kind" for its
+        # record schema ("event"/"step")
+        flightrec.get().record_event("fault_injected", site=spec.site,
+                                     fault_kind=spec.kind, hit=hit)
         logging.warning(f"FAULT INJECTED at {spec.site} "
                         f"(kind={spec.kind}, hit #{hit}"
                         + (f", path={path}" if path else "") + ")")
+        if spec.kind == "stall":
+            # A deterministic straggler: the site just goes slow.  The
+            # anomaly detector must notice on its own — nothing else
+            # about the step changes.
+            time.sleep(spec.stall_s)
+            return
         if spec.kind == "ioerror":
             raise InjectedIOError(
                 f"injected transient I/O error at {spec.site} "
@@ -251,7 +272,7 @@ def parse_plan(text: str, seed: int = 0) -> FaultPlan:
                     f"fault plan file {text!r}: faults[{i}] is not an "
                     "object")
             unknown = set(entry) - {"site", "kind", "after_n", "count",
-                                    "rank", "path_match"}
+                                    "rank", "path_match", "stall_s"}
             if unknown:
                 raise ValueError(
                     f"fault plan file {text!r}: faults[{i}] has unknown "
@@ -264,19 +285,21 @@ def parse_plan(text: str, seed: int = 0) -> FaultPlan:
         if not part:
             continue
         fields = part.split(":")
-        if len(fields) not in (3, 4):
+        if len(fields) not in (3, 4, 5):
             raise ValueError(
                 f"bad fault spec {part!r}: expected "
-                "'site:kind:after_n[:count]'")
+                "'site:kind:after_n[:count[:stall_s]]'")
         try:
             after_n = int(fields[2])
-            count = int(fields[3]) if len(fields) == 4 else 1
+            count = int(fields[3]) if len(fields) >= 4 else 1
+            stall_s = float(fields[4]) if len(fields) == 5 else 0.25
         except ValueError as e:
             raise ValueError(
                 f"bad fault spec {part!r}: after_n/count must be "
-                "integers") from e
+                "integers (and stall_s a float)") from e
         specs.append(FaultSpec(site=fields[0], kind=fields[1],
-                               after_n=after_n, count=count))
+                               after_n=after_n, count=count,
+                               stall_s=stall_s))
     if not specs:
         raise ValueError(f"empty fault plan {text!r}")
     return FaultPlan(specs, seed=seed)
@@ -362,6 +385,9 @@ class RetryPolicy:
                     tel.counter("retry/giveups").add(1)
                     tel.event("retry_giveup", site=site, attempts=attempt,
                               error=str(e), timed_out=out_of_time)
+                    flightrec.get().record_event("retry_giveup",
+                                                 site=site,
+                                                 attempts=attempt)
                     logging.error(
                         f"{site}: giving up after {attempt} attempt(s)"
                         + (" (retry deadline exceeded)" if out_of_time
@@ -371,6 +397,8 @@ class RetryPolicy:
                 tel.counter("retry/attempts").add(1)
                 tel.event("retry", site=site, attempt=attempt,
                           delay_s=delay, error=str(e))
+                flightrec.get().record_event("retry", site=site,
+                                             attempt=attempt)
                 logging.warning(
                     f"{site}: transient failure (attempt {attempt}/"
                     f"{self.max_attempts}), retrying in {delay:.3f}s: {e}")

@@ -1,0 +1,382 @@
+"""L1: flight recorder + anomaly-triggered profiling (ISSUE 7 tentpole).
+
+The telemetry JSONL (telemetry.py) answers "how did the run go" at
+epoch/summary granularity; it cannot answer "what were the last 2k steps
+doing when rank 3 died".  This module is that black box: a fixed-memory
+per-rank ring buffer of per-step records — dispatch wall time, data-wait,
+queue depth, retry/fault events — each stamped with the same paired
+``ts`` (wall) + ``mono`` (monotonic) contract as telemetry, dumped to
+``RSL_PATH/flightrec-rank{N}.json`` when something goes wrong:
+
+  * crash           — the driver's ``finally`` calls ``close()`` while an
+                      exception is propagating (reason="crash")
+  * preempt         — ``utils.GracefulShutdown`` dumps from the signal
+                      handler (reason="preempt_signal"), so the record
+                      survives even if the grace window is cut short
+  * peer failure    — ``cli._health_boundary`` dumps when the health
+                      allgather reports another rank failed: the healthy
+                      ranks' view of the minutes before is exactly what
+                      post-mortems need (reason="peer_failure")
+  * on demand       — ``dump(reason)`` / end-of-run ``close()``
+
+The recorder is cheap enough to leave on (a dict append into a bounded
+deque per step; the overhead budget is gated by scripts/anomaly_gate.py)
+and, like telemetry, is a process-local singleton: ``get()`` returns a
+disabled no-op until ``configure()`` installs the real one.
+
+Anomaly-triggered profiling: ``AnomalyDetector`` watches per-step wall
+time with a rolling median/MAD window plus two structural triggers
+(data starvation, retry bursts) and — a bounded number of times per run —
+fires a *programmatic* ``jax.profiler.start_trace`` capture of the next K
+steps into ``RSL_PATH/anomaly_traces/capture-<n>``, emitting an
+``anomaly`` telemetry event with the trigger's evidence.  Profiling
+happens exactly when a step goes anomalous, not when a human remembers to
+pass ``--profile``.  The trigger path is deterministically testable via
+the ``stall`` fault kind (faults.py): a canned plan such as
+``data.host_batch:stall:8`` makes exactly one step slow, which must
+produce exactly one capture (scripts/anomaly_gate.py proves it).
+
+Trigger semantics (all windows/thresholds are Config knobs):
+
+  step-time   window of the last W step times is full AND
+              step_s > rel_factor * median AND
+              step_s - median > max(mad_k * MAD, min_excess_s).
+              The MAD term adapts to the run's own jitter; the absolute
+              ``min_excess_s`` floor keeps micro-jitter (CPU scheduler,
+              GC) from triggering on millisecond steps.
+  starvation  the step's data-wait alone exceeds the same excess bound —
+              the queue went empty and the producer is the straggler.
+  retry-burst ≥ ``retry_burst`` retry/fault events landed since the last
+              observed step — I/O is failing faster than it succeeds.
+
+Capture lifecycle: start_trace at the triggering step, stop_trace K steps
+later (or at ``close()``, in a ``finally`` — the graftlint rule
+``profiler-trace-leak`` checks this shape); at most ``max_captures`` per
+run so a pathological run cannot fill the disk with traces.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import statistics
+import threading
+import time
+from typing import Any, Deque, Dict, List, Optional
+
+from . import telemetry
+
+
+class AnomalyDetector:
+    """Rolling median/MAD step-time monitor that owns the bounded
+    programmatic profiler captures.  One instance per run, driven from
+    the streaming train loop via ``observe_step``; NOT thread-safe by
+    design (only the driver thread observes steps)."""
+
+    def __init__(self, *, trace_dir: str, window: int = 32,
+                 mad_k: float = 8.0, rel_factor: float = 3.0,
+                 min_excess_s: float = 0.05, retry_burst: int = 3,
+                 capture_steps: int = 4, max_captures: int = 2):
+        self.trace_dir = trace_dir
+        self.window = max(int(window), 4)
+        self.mad_k = float(mad_k)
+        self.rel_factor = float(rel_factor)
+        self.min_excess_s = float(min_excess_s)
+        self.retry_burst = max(int(retry_burst), 1)
+        self.capture_steps = max(int(capture_steps), 1)
+        self.max_captures = int(max_captures)
+        self._times: Deque[float] = collections.deque(maxlen=self.window)
+        self._retries_since_step = 0
+        self.anomalies = 0
+        self.captures_started = 0
+        self._capture_left = 0  # >0 while a trace capture is running
+
+    # -- trigger evaluation -------------------------------------------
+
+    def note_retry(self) -> None:
+        """Called (via the recorder) for every retry/fault event; feeds
+        the retry-burst trigger."""
+        self._retries_since_step += 1
+
+    def _trigger(self, step_s: float, wait_s: Optional[float]
+                 ) -> Optional[Dict[str, Any]]:
+        retries = self._retries_since_step
+        self._retries_since_step = 0
+        if retries >= self.retry_burst:
+            return {"trigger": "retry_burst", "retries": retries}
+        if len(self._times) < self.window:
+            # Window not yet full: the baseline isn't trustworthy (it
+            # would include compile steps) — observe, don't judge.
+            self._times.append(step_s)
+            return None
+        med = statistics.median(self._times)
+        mad = statistics.median(abs(t - med) for t in self._times)
+        excess = step_s - med
+        bound = max(self.mad_k * mad, self.min_excess_s)
+        evidence = {"median_s": med, "mad_s": mad, "step_s": step_s}
+        self._times.append(step_s)
+        if step_s > self.rel_factor * med and excess > bound:
+            return {"trigger": "step_time", **evidence}
+        if wait_s is not None and wait_s > bound \
+                and wait_s > self.rel_factor * med:
+            return {"trigger": "starvation", "wait_s": wait_s, **evidence}
+        return None
+
+    # -- capture state machine ----------------------------------------
+
+    def observe_step(self, *, epoch: int, step: int, step_s: float,
+                     wait_s: Optional[float] = None) -> Optional[str]:
+        """Feed one completed step; returns the trigger name when this
+        step was judged anomalous (the caller records/emits the event).
+        Manages the start/stop of the bounded profiler captures."""
+        if self._capture_left > 0:
+            self._capture_left -= 1
+            if self._capture_left == 0:
+                self._stop_capture()
+            # While capturing, keep feeding the window but don't re-judge:
+            # the anomalous region itself must not retrain the baseline
+            # into silence nor trigger overlapping captures.
+            self._times.append(step_s)
+            self._retries_since_step = 0
+            return None
+        verdict = self._trigger(step_s, wait_s)
+        if verdict is None:
+            return None
+        self.anomalies += 1
+        if self.captures_started < self.max_captures:
+            self._start_capture(verdict, epoch=epoch, step=step)
+        return str(verdict["trigger"])
+
+    def _start_capture(self, verdict: Dict[str, Any], *, epoch: int,
+                       step: int) -> None:
+        import jax
+
+        path = os.path.join(self.trace_dir,
+                            f"capture-{self.captures_started}")
+        try:
+            os.makedirs(path, exist_ok=True)
+            jax.profiler.start_trace(path)
+        except Exception as e:  # profiling is advisory, never fatal
+            logging.warning(f"flightrec: start_trace failed ({e}); "
+                            f"anomaly recorded without a capture")
+            return
+        self.captures_started += 1
+        self._capture_left = self.capture_steps
+        logging.info(f"flightrec: anomaly ({verdict['trigger']}) at "
+                     f"epoch {epoch} step {step} — capturing next "
+                     f"{self.capture_steps} step(s) to {path}")
+
+    def _stop_capture(self) -> None:
+        """End-of-budget stop for the normal K-step path."""
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            # advisory: a failed stop (backend died mid-capture) must
+            # not take the training loop down with it
+            logging.warning(f"flightrec: stop_trace failed ({e})")
+
+    def close(self) -> None:
+        """End-of-run cleanup: an in-flight capture is stopped with
+        ``stop_trace`` in a ``finally``, so the profiler can never be
+        left running past the detector's lifetime (the graftlint
+        profiler-trace-leak rule keys on this guarantee)."""
+        if self._capture_left <= 0:
+            return
+        import jax
+
+        try:
+            self._capture_left = 0
+        finally:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                # close() runs inside the driver's finally — swallow
+                # everything so cleanup cannot mask the real exception
+                logging.warning(f"flightrec: close stop_trace "
+                                f"failed ({e})")
+
+
+class FlightRecorder:
+    """Fixed-memory ring buffer of step records + point events.
+
+    Disabled instances (the default singleton) are no-ops on every
+    method; enabled ones append bounded dicts — no file I/O until
+    ``dump``.  Append/dump are locked: producer threads and the signal
+    handler may record events concurrently with the driver."""
+
+    def __init__(self, enabled: bool = False, rsl_path: str = ".",
+                 rank: int = 0, ring_size: int = 4096):
+        self.enabled = enabled
+        self.rank = rank
+        self.ring_size = int(ring_size)
+        self._path = os.path.join(rsl_path,
+                                  f"flightrec-rank{rank}.json")
+        self._ring: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=max(self.ring_size, 16))
+        self._lock = threading.Lock()
+        self._dump_reasons: List[str] = []
+        self.detector: Optional[AnomalyDetector] = None
+
+    # -- recording ----------------------------------------------------
+
+    def record_step(self, *, epoch: int, step: int, step_s: float,
+                    dispatch_s: Optional[float] = None,
+                    wait_s: Optional[float] = None,
+                    queue_depth: Optional[int] = None) -> None:
+        """One completed train step: total step wall time, the dispatch
+        slice of it, the data-wait slice, and the prefetch queue depth
+        sampled after the fetch."""
+        if not self.enabled:
+            return
+        rec: Dict[str, Any] = {"kind": "step", "epoch": epoch,
+                               "step": step, "ts": time.time(),
+                               "mono": time.monotonic(),
+                               "step_s": step_s}
+        if dispatch_s is not None:
+            rec["dispatch_s"] = dispatch_s
+        if wait_s is not None:
+            rec["wait_s"] = wait_s
+        if queue_depth is not None:
+            rec["queue_depth"] = queue_depth
+        with self._lock:
+            self._ring.append(rec)
+
+    def record_event(self, name: str, **attrs: Any) -> None:
+        """Point event (retry, fault_injected, anomaly, preempt...).
+        Retry-ish events additionally feed the detector's burst
+        trigger."""
+        if not self.enabled:
+            return
+        # attrs first, reserved fields last: a caller attr named "kind"
+        # (e.g. a fault kind) must never clobber the record schema
+        rec = {**attrs, "kind": "event", "name": name, "ts": time.time(),
+               "mono": time.monotonic()}
+        with self._lock:
+            self._ring.append(rec)
+        if name in ("retry", "fault_injected") and self.detector:
+            self.detector.note_retry()
+
+    # -- dumping ------------------------------------------------------
+
+    def dump(self, reason: str) -> Optional[str]:
+        """Write the ring to ``flightrec-rank{N}.json`` (latest dump
+        wins; ``reasons`` accumulates so a preempt dump followed by the
+        end-of-run dump is visible).  Never raises: the recorder is
+        called from signal handlers and ``finally`` blocks."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            self._dump_reasons.append(reason)
+            doc = {
+                "rank": self.rank,
+                "ring_size": self.ring_size,
+                "reason": reason,
+                "reasons": list(self._dump_reasons),
+                # The dump's own paired stamp anchors the records' mono
+                # values to this host's wall clock at dump time.
+                "dumped_at": {"ts": time.time(), "mono": time.monotonic()},
+                "records": list(self._ring),
+            }
+        try:
+            tmp = self._path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f, default=float)
+            os.replace(tmp, self._path)  # never leave a torn dump
+            return self._path
+        except Exception as e:
+            # dump() is called from signal handlers and finally blocks:
+            # a full disk must degrade to a logged error, never raise
+            logging.error(f"flightrec: cannot write {self._path!r} ({e})")
+            return None
+
+    def close(self, reason: str = "run_end") -> None:
+        """Final dump + detector cleanup; idempotent (disables self)."""
+        if not self.enabled:
+            return
+        if self.detector is not None:
+            self.detector.close()
+        self.dump(reason)
+        self.enabled = False
+
+
+_active = FlightRecorder(enabled=False)
+
+
+def get() -> FlightRecorder:
+    """The process's active flight recorder (disabled no-op by
+    default)."""
+    return _active
+
+
+def configure(rsl_path: str, enabled: bool, rank: int = 0,
+              ring_size: int = 4096) -> FlightRecorder:
+    """Install the process's recorder (drivers call this once, after
+    runtime init so the rank is the global process index).  A previous
+    enabled instance is closed first — re-invocation safe."""
+    global _active
+    if _active.enabled:
+        _active.close("reconfigure")
+    _active = FlightRecorder(enabled=enabled, rsl_path=rsl_path,
+                             rank=rank, ring_size=ring_size)
+    return _active
+
+
+def attach_detector(rec: FlightRecorder, *, trace_dir: str,
+                    **knobs: Any) -> Optional[AnomalyDetector]:
+    """Create + attach the anomaly detector to an enabled recorder and
+    return it (None on a disabled recorder — anomaly capture requires
+    the flight recorder, since the captures are explained by its
+    records)."""
+    if not rec.enabled:
+        return None
+    rec.detector = AnomalyDetector(trace_dir=trace_dir, **knobs)
+    return rec.detector
+
+
+def observe_step(rec: FlightRecorder, *, epoch: int, step: int,
+                 step_s: float, dispatch_s: Optional[float] = None,
+                 wait_s: Optional[float] = None,
+                 queue_depth: Optional[int] = None) -> None:
+    """Hot-loop helper: record the step and, if a detector is attached,
+    judge it — emitting the ``anomaly`` event on both sinks when it
+    fires."""
+    rec.record_step(epoch=epoch, step=step, step_s=step_s,
+                    dispatch_s=dispatch_s, wait_s=wait_s,
+                    queue_depth=queue_depth)
+    det = rec.detector
+    if det is None:
+        return
+    trigger = det.observe_step(epoch=epoch, step=step, step_s=step_s,
+                               wait_s=wait_s)
+    if trigger is not None:
+        rec.record_event("anomaly", trigger=trigger, epoch=epoch,
+                         step=step, step_s=step_s)
+        telemetry.get().event("anomaly", trigger=trigger, epoch=epoch,
+                              step=step, step_s=step_s,
+                              captures=det.captures_started)
+
+
+def load_dumps(rsl_path: str) -> Dict[int, Dict[str, Any]]:
+    """All ``flightrec-rank*.json`` dumps under a run dir, keyed by rank.
+    Unreadable/torn dumps are skipped (the timeline merger degrades to
+    telemetry-only for that rank)."""
+    out: Dict[int, Dict[str, Any]] = {}
+    try:
+        names = sorted(os.listdir(rsl_path))
+    except OSError:
+        return out
+    for fn in names:
+        if not (fn.startswith("flightrec-rank")
+                and fn.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(rsl_path, fn), encoding="utf-8") as f:
+                doc = json.load(f)
+            out[int(doc["rank"])] = doc
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+    return out

@@ -18,14 +18,29 @@ the off state adds no per-step work (acceptance criterion).  Events are
 buffered and flushed at epoch/close boundaries — the hot loop never does
 file I/O.
 
-Event schema (one JSON object per line; every line carries ``ts`` —
-epoch seconds — and ``rank``):
+Timestamp contract (every line carries all three):
+
+  ``ts``    wall clock (``time.time()``, epoch seconds) — for humans and
+            for cross-host correlation ONLY; hosts' wall clocks skew and
+            step, so nothing may be ordered by it.
+  ``mono``  monotonic clock (``time.monotonic()``, arbitrary per-process
+            origin) — the ordering clock.  Within one rank file ``mono``
+            is non-decreasing in real time; the timeline merger orders
+            and aligns ranks on ``mono`` (offset-corrected at health-
+            allgather boundaries) and never trusts ``ts`` for ordering.
+  ``rank``  global process index.
+
+Span durations (``dur_s``) are measured with ``perf_counter`` and are
+independent of both stamps; both stamps are taken at *emit* time, which
+for spans is span END (start = stamp - dur_s).
+
+Event schema (one JSON object per line):
 
   kind="span"       name, dur_s, parent (enclosing span name or null),
                     attrs (span-specific: epoch, step count, path, ...)
   kind="counter"    name, value       (monotonic total, emitted at flush)
   kind="gauge"      name, value, attrs (emitted on every set)
-  kind="histogram"  name, count, sum, min, max, mean, p50, p90, p99
+  kind="histogram"  name, count, sum, min, max, mean, p50, p90, p95, p99
                     (summary, emitted at flush)
   kind="event"      name, attrs       (point events: preemption, meta)
 
@@ -94,7 +109,7 @@ class Gauge:
 
 class Histogram:
     """Timing histogram: stores observations (bounded), summarized at
-    flush with count/sum/min/max/mean and p50/p90/p99."""
+    flush with count/sum/min/max/mean and p50/p90/p95/p99."""
 
     __slots__ = ("name", "count", "sum", "min", "max", "_samples")
 
@@ -123,7 +138,8 @@ class Histogram:
             return out
         out.update(min=self.min, max=self.max, mean=self.sum / self.count)
         s = sorted(self._samples)
-        for q, label in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+        for q, label in ((0.5, "p50"), (0.9, "p90"), (0.95, "p95"),
+                         (0.99, "p99")):
             out[label] = s[min(len(s) - 1, int(q * len(s)))]
         return out
 
@@ -244,7 +260,9 @@ class Telemetry:
     def _emit(self, payload: Dict[str, Any]) -> None:
         if not self.enabled:
             return
+        # Paired stamps — see the module-docstring timestamp contract.
         payload["ts"] = time.time()
+        payload["mono"] = time.monotonic()
         payload["rank"] = self.rank
         line = json.dumps(payload, sort_keys=True, default=float)
         with self._lock:
@@ -488,6 +506,34 @@ def render_report(agg: Dict[str, Any]) -> str:
             lines.append(f"  {name:<16} {s['count']:>6} "
                          f"{s['total_s']:>10.3f} {s['mean_s']:>10.3f} "
                          f"{s['max_s']:>10.3f}")
+
+    hists = agg["histograms"]
+    if hists:
+        lines.append("")
+        lines.append("hot-path duration percentiles (per-step histograms; "
+                     "count-weighted across ranks):")
+        lines.append(f"  {'histogram':<20} {'count':>8} {'p50':>10} "
+                     f"{'p95':>10} {'p99':>10} {'max':>10}")
+        for name in sorted(hists):
+            summaries = [h for h in hists[name] if h.get("count")]
+            if not summaries:
+                continue
+            n = sum(int(h["count"]) for h in summaries)
+
+            def _wq(label, summaries=summaries, n=n):
+                # Exact per-rank quantiles don't merge; the count-weighted
+                # mean is the documented approximation (single-rank runs —
+                # the common case — are exact).
+                vals = [(float(h.get(label, 0.0)), int(h["count"]))
+                        for h in summaries if label in h]
+                if not vals:
+                    return 0.0
+                return sum(v * c for v, c in vals) / sum(c for _, c in vals)
+
+            mx = max(float(h.get("max", 0.0)) for h in summaries)
+            lines.append(f"  {name:<20} {n:>8} {_wq('p50'):>10.4f} "
+                         f"{_wq('p95'):>10.4f} {_wq('p99'):>10.4f} "
+                         f"{mx:>10.4f}")
 
     per_rank = agg["epoch_s_per_rank"]
     if len(per_rank) > 1:

@@ -1,0 +1,362 @@
+"""L2: cross-rank timeline — telemetry + flight records as one trace.
+
+``python main.py timeline --rsl_path RSL`` merges every rank's telemetry
+JSONL (telemetry/rank*.jsonl) and flight-recorder dump
+(flightrec-rank*.json) into a single Chrome trace-event file that
+Perfetto (https://ui.perfetto.dev) or chrome://tracing loads directly:
+one process row per rank, telemetry spans and flight-recorder steps on
+separate threads, point events (anomaly, fault_injected, preempt_signal,
+health_boundary) as instants.
+
+Clock alignment.  Each rank stamps records with its own ``mono`` clock,
+whose origin is arbitrary per process — raw mono values from two ranks
+are not comparable.  Wall clocks (``ts``) are comparable but can be
+skewed between hosts.  The merger therefore aligns on the PR 4 health
+allgather: ``cli._health_boundary`` emits a ``health_boundary`` event on
+every rank immediately after ``runtime.agree_health`` returns, and a
+blocking allgather returns at (nearly) the same real instant everywhere —
+so for each epoch boundary e, mono_r(e) on every rank r names the same
+physical moment.  Rank r's offset onto rank 0's mono axis is the median
+over shared boundaries of ``mono_0(e) - mono_r(e)``; the median makes one
+straggly boundary (a rank that lingered in the allgather) harmless.
+Runs without shared boundaries (single rank, --no-health-checks) fall
+back to wall-clock alignment via each rank's median ``ts - mono`` delta —
+correct up to host clock skew, which the skew report then quantifies.
+
+Skew report.  At every shared boundary the ranks' *wall* stamps should
+agree too; their spread (max - min) is the measured cross-rank wall-clock
+skew per epoch, reported per boundary and as a maximum.  The straggler
+table attributes per-rank time: mean epoch span, mean step time and
+data-wait share from the flight records — the rank that is slow because
+it waits on data reads differently from the rank that is slow dispatching.
+
+Hostile inputs degrade, never crash: a missing flight record for one rank
+drops to telemetry-only for that rank (warning in the summary), torn
+JSONL tails are skipped line-wise, and a run directory with no telemetry
+at all is a one-line actionable error (``ValueError``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import flightrec, telemetry
+
+# Thread ids within each rank's process row.
+_TID_SPANS = 0      # telemetry spans
+_TID_STEPS = 1      # flight-recorder per-step records
+_TID_EVENTS = 2     # point events / instants
+
+
+def _attrs(ev: Dict[str, Any]) -> Dict[str, Any]:
+    a = ev.get("attrs")
+    return a if isinstance(a, dict) else {}
+
+
+def _boundaries(events: List[Dict[str, Any]]
+                ) -> Dict[int, Dict[int, Dict[str, float]]]:
+    """rank -> epoch -> {"ts","mono"} for every health_boundary event.
+    A rank that emitted the same epoch twice keeps the last stamp (a
+    resumed run re-walks earlier epochs)."""
+    out: Dict[int, Dict[int, Dict[str, float]]] = {}
+    for ev in events:
+        if ev.get("kind") != "event" or ev.get("name") != "health_boundary":
+            continue
+        try:
+            rank = int(ev["rank"])
+            epoch = int(_attrs(ev)["epoch"])
+            stamp = {"ts": float(ev["ts"]), "mono": float(ev["mono"])}
+        except (KeyError, TypeError, ValueError):
+            continue
+        out.setdefault(rank, {})[epoch] = stamp
+    return out
+
+
+def _wall_delta(events: List[Dict[str, Any]], rank: int) -> Optional[float]:
+    """Median ``ts - mono`` for one rank: maps its mono clock onto its
+    own wall clock (the no-boundary fallback alignment)."""
+    deltas = [float(ev["ts"]) - float(ev["mono"]) for ev in events
+              if ev.get("rank") == rank
+              and isinstance(ev.get("ts"), (int, float))
+              and isinstance(ev.get("mono"), (int, float))]
+    return statistics.median(deltas) if deltas else None
+
+
+def _alignment(events: List[Dict[str, Any]], ranks: List[int]
+               ) -> Tuple[Dict[int, float], str, List[str]]:
+    """Per-rank offset to add to that rank's mono stamps so all ranks
+    share one time axis.  Returns (offsets, method, warnings)."""
+    warnings: List[str] = []
+    bounds = _boundaries(events)
+    offsets: Dict[int, float] = {}
+    base = min(ranks)
+    if base in bounds and len(ranks) > 1:
+        offsets[base] = 0.0
+        aligned = True
+        for r in ranks:
+            if r == base:
+                continue
+            shared = sorted(set(bounds.get(r, {})) & set(bounds[base]))
+            if not shared:
+                aligned = False
+                break
+            offsets[r] = statistics.median(
+                bounds[base][e]["mono"] - bounds[r][e]["mono"]
+                for e in shared)
+        if aligned:
+            return offsets, "health_boundary", warnings
+        warnings.append("clock alignment: not every rank shares a "
+                        "health_boundary with rank "
+                        f"{base}; falling back to wall clocks")
+    # Fallback: project every rank onto its own wall clock.  Correct up
+    # to host clock skew (single-rank runs trivially so).
+    offsets = {}
+    for r in ranks:
+        d = _wall_delta(events, r)
+        offsets[r] = d if d is not None else 0.0
+    return offsets, "wall_clock", warnings
+
+
+def _skew_report(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Cross-rank wall-clock spread at each shared boundary epoch."""
+    bounds = _boundaries(events)
+    per_epoch: Dict[int, float] = {}
+    epochs = set()
+    for stamps in bounds.values():
+        epochs |= set(stamps)
+    for e in sorted(epochs):
+        walls = [stamps[e]["ts"] for stamps in bounds.values()
+                 if e in stamps]
+        if len(walls) >= 2:
+            per_epoch[e] = max(walls) - min(walls)
+    return {
+        "boundary_epochs": sorted(epochs),
+        "wall_skew_s_per_epoch": {str(e): round(v, 6)
+                                  for e, v in per_epoch.items()},
+        "max_wall_skew_s": (round(max(per_epoch.values()), 6)
+                            if per_epoch else None),
+    }
+
+
+def _stragglers(events: List[Dict[str, Any]],
+                dumps: Dict[int, Dict[str, Any]],
+                ranks: List[int]) -> List[Dict[str, Any]]:
+    """Per-rank attribution rows; the slowest mean epoch is flagged."""
+    rows: List[Dict[str, Any]] = []
+    for r in ranks:
+        epoch_durs = [float(ev["dur_s"]) for ev in events
+                      if ev.get("kind") == "span"
+                      and ev.get("name") == "epoch"
+                      and ev.get("rank") == r
+                      and isinstance(ev.get("dur_s"), (int, float))]
+        steps = [rec for rec in dumps.get(r, {}).get("records", [])
+                 if isinstance(rec, dict) and rec.get("kind") == "step"]
+        step_s = [float(s["step_s"]) for s in steps
+                  if isinstance(s.get("step_s"), (int, float))]
+        wait_s = [float(s["wait_s"]) for s in steps
+                  if isinstance(s.get("wait_s"), (int, float))]
+        row: Dict[str, Any] = {
+            "rank": r,
+            "epochs_seen": len(epoch_durs),
+            "mean_epoch_s": (round(statistics.mean(epoch_durs), 6)
+                             if epoch_durs else None),
+            "steps_recorded": len(steps),
+            "mean_step_s": (round(statistics.mean(step_s), 6)
+                            if step_s else None),
+            "data_wait_share": (round(sum(wait_s) / max(sum(step_s), 1e-12),
+                                      4) if wait_s and step_s else None),
+        }
+        rows.append(row)
+    timed = [row for row in rows if row["mean_epoch_s"] is not None]
+    if timed:
+        slowest = max(timed, key=lambda row: row["mean_epoch_s"])
+        slowest["straggler"] = True
+    return rows
+
+
+def build_timeline(rsl_path: str) -> Dict[str, Any]:
+    """Merge one run directory into {trace, skew, stragglers, ...}.
+
+    Raises ``ValueError`` (one actionable line) when the run has no
+    telemetry at all; every lesser defect degrades with a warning."""
+    events = telemetry.load_events(os.path.join(rsl_path, "telemetry"))
+    dumps = flightrec.load_dumps(rsl_path)
+    ranks = sorted({int(ev["rank"]) for ev in events
+                    if isinstance(ev.get("rank"), int)} | set(dumps))
+    if not ranks:
+        raise ValueError(
+            f"telemetry under {rsl_path!r} has no rank-stamped events; "
+            "was it produced by an older build? re-run with --telemetry")
+    offsets, method, warnings = _alignment(events, ranks)
+    for r in ranks:
+        if r not in dumps:
+            warnings.append(f"no flight record for rank {r} "
+                            f"(flightrec-rank{r}.json missing/unreadable); "
+                            "timeline shows telemetry spans only")
+
+    def aligned(rank: int, mono: float) -> float:
+        return mono + offsets.get(rank, 0.0)
+
+    # First pass: the trace origin is the earliest aligned stamp so every
+    # Chrome ts is non-negative.
+    stamps: List[float] = []
+    for ev in events:
+        if isinstance(ev.get("mono"), (int, float)) \
+                and isinstance(ev.get("rank"), int):
+            t = aligned(ev["rank"], float(ev["mono"]))
+            if ev.get("kind") == "span" \
+                    and isinstance(ev.get("dur_s"), (int, float)):
+                t -= float(ev["dur_s"])  # span stamps are END stamps
+            stamps.append(t)
+    for r, doc in dumps.items():
+        for rec in doc.get("records", []):
+            if isinstance(rec, dict) \
+                    and isinstance(rec.get("mono"), (int, float)):
+                t = aligned(r, float(rec["mono"]))
+                if isinstance(rec.get("step_s"), (int, float)):
+                    t -= float(rec["step_s"])
+                stamps.append(t)
+    if not stamps:
+        raise ValueError(
+            f"no timestamped records under {rsl_path!r}; nothing to plot")
+    origin = min(stamps)
+
+    def us(rank: int, mono: float) -> float:
+        return round((aligned(rank, float(mono)) - origin) * 1e6, 3)
+
+    trace_events: List[Dict[str, Any]] = []
+    for r in ranks:
+        trace_events.append({"ph": "M", "name": "process_name", "pid": r,
+                             "args": {"name": f"rank{r}"}})
+        trace_events.append({"ph": "M", "name": "process_sort_index",
+                             "pid": r, "args": {"sort_index": r}})
+        for tid, label in ((_TID_SPANS, "telemetry spans"),
+                           (_TID_STEPS, "flightrec steps"),
+                           (_TID_EVENTS, "events")):
+            trace_events.append({"ph": "M", "name": "thread_name",
+                                 "pid": r, "tid": tid,
+                                 "args": {"name": label}})
+
+    for ev in events:
+        r = ev.get("rank")
+        mono = ev.get("mono")
+        if not isinstance(r, int) or not isinstance(mono, (int, float)):
+            continue
+        kind = ev.get("kind")
+        if kind == "span" and isinstance(ev.get("dur_s"), (int, float)):
+            dur = float(ev["dur_s"])
+            trace_events.append({
+                "ph": "X", "cat": "telemetry",
+                "name": str(ev.get("name", "span")), "pid": r,
+                "tid": _TID_SPANS,
+                "ts": us(r, float(mono) - dur), "dur": round(dur * 1e6, 3),
+                "args": _attrs(ev),
+            })
+        elif kind == "event":
+            trace_events.append({
+                "ph": "i", "cat": "telemetry", "s": "p",
+                "name": str(ev.get("name", "event")), "pid": r,
+                "tid": _TID_EVENTS, "ts": us(r, mono),
+                "args": _attrs(ev),
+            })
+    for r, doc in dumps.items():
+        for rec in doc.get("records", []):
+            if not isinstance(rec, dict) \
+                    or not isinstance(rec.get("mono"), (int, float)):
+                continue
+            if rec.get("kind") == "step" \
+                    and isinstance(rec.get("step_s"), (int, float)):
+                dur = float(rec["step_s"])
+                args = {k: rec[k] for k in ("epoch", "step", "dispatch_s",
+                                            "wait_s", "queue_depth")
+                        if k in rec}
+                trace_events.append({
+                    "ph": "X", "cat": "flightrec", "name": "step",
+                    "pid": r, "tid": _TID_STEPS,
+                    "ts": us(r, float(rec["mono"]) - dur),
+                    "dur": round(dur * 1e6, 3), "args": args,
+                })
+            elif rec.get("kind") == "event":
+                trace_events.append({
+                    "ph": "i", "cat": "flightrec", "s": "p",
+                    "name": str(rec.get("name", "event")), "pid": r,
+                    "tid": _TID_EVENTS, "ts": us(r, rec["mono"]),
+                    "args": {k: v for k, v in rec.items()
+                             if k not in ("kind", "name", "ts", "mono")},
+                })
+    # Stable per-rank ordering: metadata first, then strictly by
+    # (pid, ts) — Perfetto tolerates any order, humans and tests don't.
+    trace_events.sort(key=lambda e: (e.get("pid", -1),
+                                     0 if e["ph"] == "M" else 1,
+                                     e.get("ts", -1.0)))
+
+    skew = _skew_report(events)
+    stragglers = _stragglers(events, dumps, ranks)
+    trace = {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": "distributedpytorch_tpu timeline",
+            "alignment": method,
+            "ranks": ranks,
+            "skew": skew,
+            "stragglers": stragglers,
+        },
+    }
+    return {"trace": trace, "skew": skew, "stragglers": stragglers,
+            "ranks": ranks, "alignment": method, "warnings": warnings}
+
+
+def render_summary(result: Dict[str, Any], out_path: str) -> str:
+    """Human-readable digest printed by the CLI next to the trace file."""
+    lines = [f"timeline: {len(result['ranks'])} rank(s), clock alignment "
+             f"via {result['alignment']}",
+             f"wrote {out_path} (load in https://ui.perfetto.dev)"]
+    for w in result["warnings"]:
+        lines.append(f"warning: {w}")
+    skew = result["skew"]
+    if skew["max_wall_skew_s"] is not None:
+        lines.append(f"cross-rank wall-clock skew: "
+                     f"max {skew['max_wall_skew_s'] * 1e3:.3f} ms")
+        for e, v in skew["wall_skew_s_per_epoch"].items():
+            lines.append(f"  boundary epoch {e}: {v * 1e3:.3f} ms")
+    else:
+        lines.append("cross-rank wall-clock skew: n/a "
+                     "(fewer than 2 ranks at any health boundary)")
+    lines.append("straggler attribution:")
+    lines.append(f"  {'rank':>4s} {'epochs':>6s} {'mean_epoch_s':>12s} "
+                 f"{'steps':>6s} {'mean_step_s':>12s} {'wait_share':>10s}")
+    for row in result["stragglers"]:
+
+        def _f(v, spec):
+            return format(v, spec) if v is not None else "-"
+
+        flag = "  <- straggler" if row.get("straggler") else ""
+        lines.append(
+            f"  {row['rank']:>4d} {row['epochs_seen']:>6d} "
+            f"{_f(row['mean_epoch_s'], '>12.4f')} "
+            f"{row['steps_recorded']:>6d} "
+            f"{_f(row['mean_step_s'], '>12.5f')} "
+            f"{_f(row['data_wait_share'], '>10.3f')}{flag}")
+    return "\n".join(lines)
+
+
+def write_timeline(rsl_path: str, out: Optional[str] = None
+                   ) -> Tuple[str, Dict[str, Any]]:
+    """Build + write the trace JSON; returns (path, build result)."""
+    result = build_timeline(rsl_path)
+    path = out or os.path.join(rsl_path, "timeline.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(result["trace"], f, default=float)
+    os.replace(tmp, path)
+    return path, result
+
+
+def run_cli(rsl_path: str, out: Optional[str] = None) -> str:
+    """CLI entry point: write the trace, return the printable summary."""
+    path, result = write_timeline(rsl_path, out=out)
+    return render_summary(result, path)

@@ -84,9 +84,15 @@ class GracefulShutdown:
         # Telemetry point event, buffered (no file I/O in the handler);
         # the epoch-boundary flush or close() writes it out, so even a
         # preempted run's JSONL records when the signal landed.
-        from . import telemetry
+        from . import flightrec, telemetry
 
         telemetry.get().event("preempt_signal", signum=int(signum))
+        # The flight recorder DOES dump here (one bounded JSON write):
+        # the grace window may be cut short by the platform, and the
+        # black box is only worth carrying if it survives the preempt.
+        rec = flightrec.get()
+        rec.record_event("preempt_signal", signum=int(signum))
+        rec.dump("preempt_signal")
         logging.warning(
             f"received signal {signum}: finishing the current epoch, "
             "then checkpointing and exiting (repeat to abort immediately)")

@@ -1,0 +1,307 @@
+#!/usr/bin/env python3
+"""Anomaly/flight-recorder gate leg (scripts/gate.sh): the black box and
+the anomaly profiler, end to end, on CPU.
+
+Four stages, all bounded:
+
+  A. deterministic trigger — a 2-epoch synthetic run under a canned
+     ``data.host_batch:stall`` fault plan with --anomaly-capture: the
+     single injected stall must produce >=1 ``anomaly`` telemetry event,
+     EXACTLY one programmatic profiler capture directory (with real
+     profile output in it — start_trace AND stop_trace both ran), and a
+     ``flightrec-rank0.json`` dump whose ring carries the anomaly.
+  B. clean control — the same run with NO fault plan: zero anomaly
+     events and zero captures (the detector's thresholds must not fire
+     on the run's own jitter), while the flight recorder still dumps at
+     run end.
+  C. overhead budget — the recorder is on by default, so it must be
+     near-free: min-of-2 timed runs with the recorder ON vs OFF (same
+     run dir per variant, so run 2 hits the persistent compile cache and
+     the minimum measures steady state) must stay within 3% (+0.75 s
+     absolute floor for scheduler noise on these ~10 s CPU runs).
+  D. 2-rank timeline — two real processes (gloo rendezvous) share one
+     run dir; ``main.py timeline`` on it must emit valid Chrome
+     trace-event JSON with both ranks, per-rank monotonic event order,
+     health-boundary clock alignment and a cross-rank skew summary.
+
+Run as ``env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/anomaly_gate.py``.
+The script re-execs itself with ``--child`` for stage D's ranks.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+OVERHEAD_REL = 0.03     # recorder-on budget vs recorder-off
+OVERHEAD_ABS_S = 0.75   # noise floor for short CPU runs
+CHILD_DEADLINE_S = 420.0
+
+# One stall late in epoch 0 (25 steps/epoch at batch 8 over the 200
+# synthetic examples): the detector's window (8) is full and the 0.5 s
+# sleep dwarfs every threshold — fires deterministically, exactly once.
+STALL_PLAN = "data.host_batch:stall:12:1:0.5"
+
+
+def _events(rsl: str, rank: int = 0) -> list:
+    path = os.path.join(rsl, "telemetry", f"rank{rank}.jsonl")
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _named(events: list, name: str) -> list:
+    return [e for e in events
+            if e.get("kind") == "event" and e.get("name") == name]
+
+
+def _base_cfg(rsl: str, **overrides):
+    from distributedpytorch_tpu.config import Config
+
+    return Config(action="train", data_path="/nodata", rsl_path=rsl,
+                  dataset="synthetic", model_name="mlp", batch_size=8,
+                  nb_epochs=2, debug=True, half_precision=False,
+                  telemetry=True, data_mode="stream", producer_threads=1,
+                  ckpt_async=True, aot_warmup=True).replace(**overrides)
+
+
+def _capture_dirs(rsl: str) -> list:
+    d = os.path.join(rsl, "anomaly_traces")
+    if not os.path.isdir(d):
+        return []
+    return sorted(n for n in os.listdir(d) if n.startswith("capture-"))
+
+
+def _capture_has_profile(rsl: str, name: str) -> bool:
+    for _, _, files in os.walk(os.path.join(rsl, "anomaly_traces", name)):
+        if files:
+            return True
+    return False
+
+
+def main() -> int:
+    from __graft_entry__ import _force_cpu_devices
+
+    _force_cpu_devices(1)
+
+    from distributedpytorch_tpu.cli import run_train
+
+    problems = []
+    work = tempfile.mkdtemp(prefix="anomaly_gate_")
+    anomaly_knobs = dict(anomaly_capture=True, anomaly_window=8,
+                         anomaly_capture_steps=2, anomaly_max_captures=1)
+
+    # -- stage A: deterministic trigger -------------------------------
+    rsl_a = os.path.join(work, "stall")
+    run_train(_base_cfg(rsl_a, fault_plan=STALL_PLAN, **anomaly_knobs))
+    ev = _events(rsl_a)
+    anomalies = _named(ev, "anomaly")
+    if not anomalies:
+        problems.append("stall run produced no anomaly telemetry event "
+                        "— the detector missed the injected 0.5s stall")
+    caps = _capture_dirs(rsl_a)
+    if len(caps) != 1:
+        problems.append(f"stall run produced {len(caps)} capture dirs "
+                        f"{caps}, expected exactly one")
+    elif not _capture_has_profile(rsl_a, caps[0]):
+        problems.append(f"capture dir {caps[0]} is empty — stop_trace "
+                        f"never flushed the programmatic profile")
+    fr_path = os.path.join(rsl_a, "flightrec-rank0.json")
+    try:
+        with open(fr_path) as f:
+            fr = json.load(f)
+        ring_anoms = [r for r in fr["records"]
+                      if r.get("kind") == "event"
+                      and r.get("name") == "anomaly"]
+        if not ring_anoms:
+            problems.append("flight-record ring has no anomaly event — "
+                            "recorder and detector are not wired "
+                            "together")
+        if "run_end" not in fr.get("reasons", []):
+            problems.append(f"flight record reasons {fr.get('reasons')} "
+                            f"missing run_end")
+    except (OSError, ValueError, KeyError) as e:
+        problems.append(f"no readable flight record at {fr_path} ({e})")
+    print(f"anomaly gate A: {len(anomalies)} anomaly event(s) "
+          f"({anomalies[0]['attrs']['trigger'] if anomalies else '-'}), "
+          f"{len(caps)} capture(s), flight record dumped")
+
+    # -- stage B: clean control (no false positives) ------------------
+    rsl_b = os.path.join(work, "clean")
+    run_train(_base_cfg(rsl_b, **anomaly_knobs))
+    ev = _events(rsl_b)
+    false_pos = _named(ev, "anomaly")
+    if false_pos:
+        problems.append(
+            f"clean run fired {len(false_pos)} anomaly event(s) "
+            f"({sorted(e['attrs'].get('trigger') for e in false_pos)}) — "
+            f"thresholds trigger on the run's own jitter")
+    caps = _capture_dirs(rsl_b)
+    if caps:
+        problems.append(f"clean run started capture(s) {caps} — "
+                        f"captures without anomalies")
+    if not os.path.exists(os.path.join(rsl_b, "flightrec-rank0.json")):
+        problems.append("clean run left no flight-record dump at "
+                        "run end")
+    print(f"anomaly gate B: clean run — {len(false_pos)} anomalies, "
+          f"{len(caps)} captures (both must be 0)")
+
+    # -- stage C: recorder overhead budget ----------------------------
+    def timed(rsl: str, flightrec: bool) -> float:
+        best = float("inf")
+        for _ in range(2):  # same rsl: run 2 reuses the compile cache
+            t0 = time.perf_counter()
+            run_train(_base_cfg(rsl, flightrec=flightrec))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_off = timed(os.path.join(work, "rec_off"), flightrec=False)
+    t_on = timed(os.path.join(work, "rec_on"), flightrec=True)
+    budget = t_off * (1.0 + OVERHEAD_REL) + OVERHEAD_ABS_S
+    if t_on > budget:
+        problems.append(
+            f"recorder overhead: on={t_on:.2f}s vs off={t_off:.2f}s "
+            f"exceeds the {OVERHEAD_REL:.0%}+{OVERHEAD_ABS_S}s budget "
+            f"({budget:.2f}s) — the default-on recorder is too "
+            f"expensive")
+    print(f"anomaly gate C: recorder on={t_on:.2f}s off={t_off:.2f}s "
+          f"(budget {budget:.2f}s)")
+
+    # -- stage D: 2-rank gloo run -> timeline -------------------------
+    problems += _stage_timeline(work)
+
+    for p in problems:
+        print(f"PROBLEM: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print("anomaly gate OK: deterministic trigger, clean control, "
+          "overhead budget and 2-rank timeline all green")
+    return 0
+
+
+def _stage_timeline(work: str) -> list:
+    """Two real ranks (gloo) share one run dir; the timeline CLI must
+    merge them into valid Chrome trace JSON with a skew summary."""
+    import socket
+
+    problems = []
+    rsl = os.path.join(work, "tworank")
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        coord = f"localhost:{s.getsockname()[1]}"
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs, logs = [], []
+    for pid in range(2):
+        log = os.path.join(work, f"tworank{pid}.log")
+        logs.append(log)
+        # A log FILE, never a pipe: an undrained pipe backpressures a
+        # chatty child into blocking mid-collective.
+        out = open(log, "ab")
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             "--coord", coord, "--pid", str(pid), "--rsl", rsl],
+            cwd=REPO, env=env, stdout=out, stderr=out))
+    deadline = time.monotonic() + CHILD_DEADLINE_S
+    for pid, p in enumerate(procs):
+        try:
+            rc = p.wait(timeout=max(1.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            problems.append(f"timeline rank {pid} HUNG past "
+                            f"{CHILD_DEADLINE_S:.0f}s\n{_tail(logs[pid])}")
+            continue
+        if rc != 0:
+            problems.append(f"timeline rank {pid} exited rc={rc}"
+                            f"\n{_tail(logs[pid])}")
+    if problems:
+        return problems
+
+    # The merger runs exactly as a user would run it.
+    merged = subprocess.run(
+        [sys.executable, "main.py", "timeline", "--rsl_path", rsl],
+        cwd=REPO, env={**env, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True)
+    if merged.returncode != 0:
+        return [f"main.py timeline failed rc={merged.returncode}:\n"
+                f"{merged.stdout[-1500:]}\n{merged.stderr[-1500:]}"]
+    try:
+        with open(os.path.join(rsl, "timeline.json")) as f:
+            trace = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"timeline.json unreadable ({e})"]
+    evs = trace.get("traceEvents")
+    if not isinstance(evs, list) or not evs:
+        problems.append("timeline.json has no traceEvents")
+        return problems
+    pids = {e.get("pid") for e in evs}
+    if not {0, 1} <= pids:
+        problems.append(f"timeline covers pids {sorted(pids)}, "
+                        f"expected both ranks 0 and 1")
+    for pid in (0, 1):
+        ts = [e["ts"] for e in evs
+              if e.get("pid") == pid and e.get("ph") != "M"]
+        if ts != sorted(ts):
+            problems.append(f"rank {pid} trace events are not in "
+                            f"monotonic ts order")
+        if any(t < 0 for t in ts):
+            problems.append(f"rank {pid} has negative trace timestamps")
+    other = trace.get("otherData", {})
+    if other.get("alignment") != "health_boundary":
+        problems.append(f"2-rank run aligned via "
+                        f"{other.get('alignment')!r}, expected "
+                        f"'health_boundary'")
+    if other.get("skew", {}).get("max_wall_skew_s") is None:
+        problems.append("no cross-rank skew summary in the trace "
+                        "(otherData.skew.max_wall_skew_s is null)")
+    if "skew" not in merged.stdout:
+        problems.append("timeline CLI summary does not mention skew")
+    if not problems:
+        print(f"anomaly gate D: 2-rank timeline valid "
+              f"({len(evs)} trace events, max skew "
+              f"{other['skew']['max_wall_skew_s']}s)")
+    return problems
+
+
+def _tail(path: str, n: int = 2500) -> str:
+    try:
+        return open(path).read()[-n:]
+    except OSError:
+        return "<no log>"
+
+
+def child_main(a) -> int:
+    """One stage-D rank: join the gloo rendezvous and run a short clean
+    training into the SHARED run dir."""
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+    from distributedpytorch_tpu import runtime
+    from distributedpytorch_tpu.cli import run_train
+
+    runtime.initialize_distributed(coordinator_address=a.coord,
+                                   num_processes=2, process_id=a.pid)
+    run_train(_base_cfg(a.rsl, batch_size=4, producer_threads=0,
+                        ckpt_async=False))
+    return 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--coord")
+    ap.add_argument("--pid", type=int)
+    ap.add_argument("--rsl")
+    args = ap.parse_args()
+    sys.exit(child_main(args) if args.child else main())

@@ -18,7 +18,12 @@
 #      read errors, mid-run SIGTERM, torn head checkpoint, two-rank
 #      fatal fault) proving every failure path recovers — see
 #      scripts/chaos_gate.py and README "Fault tolerance & chaos testing"
-#   5. the driver's own gate: __graft_entry__.dryrun_multichip(8)
+#   5. anomaly gate: deterministic stall -> anomaly event + exactly one
+#      programmatic profiler capture + flight-record dump; clean-run
+#      false-positive check; recorder overhead budget; 2-rank timeline
+#      merge — see scripts/anomaly_gate.py and README "Flight recorder,
+#      anomaly profiling & timeline"
+#   6. the driver's own gate: __graft_entry__.dryrun_multichip(8)
 #      (clean env, exactly as the driver runs it)
 #
 # Tier map:
@@ -59,6 +64,9 @@ env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/graftlint.py --smoke
 
 echo "== gate: chaos (fault injection / retry / lineage recovery) =="
 env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py
+
+echo "== gate: anomaly (flight recorder / capture / timeline) =="
+env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/anomaly_gate.py
 
 echo "== gate: dryrun_multichip(8) =="
 env -u XLA_FLAGS -u JAX_PLATFORMS python -c \

@@ -48,7 +48,7 @@ def main() -> int:
     import jax.numpy as jnp
 
     from bench import peak_flops, _make_corpus
-    from distributedpytorch_tpu import runtime, utils
+    from distributedpytorch_tpu import costs, runtime, utils
     from distributedpytorch_tpu.data import augment
     from distributedpytorch_tpu.data.pipeline import ResidentLoader
     from distributedpytorch_tpu.models import get_model, get_model_input_size
@@ -166,9 +166,12 @@ def main() -> int:
         results[name] = per_step
         log(f"{name:26s} {per_step * 1e6:8.1f} us/step")
 
-    # full program: the real train_epoch (AOT-compiled like the bench)
+    # full program: the real train_epoch (AOT-compiled like the bench);
+    # its XLA cost estimate goes into the shared registry (costs.py) so
+    # this report and the runtime MFU gauge quote the same numbers.
     compiled = engine.train_epoch.lower(
         state, images_all, labels_all, idx, valid, key).compile()
+    costs.record("train_epoch", compiled)
     st, m = compiled(state, images_all, labels_all, idx, valid, key)
     jax.block_until_ready(m["loss"])
     t0 = time.monotonic()
@@ -184,6 +187,8 @@ def main() -> int:
     host_bs = jax.device_get(st.batch_stats)
     fps = flops_mod.train_flops_per_sample(
         engine.model, host_params, host_bs, batch=gb, input_size=out_dim)
+    costs.record_analytic("train_flops_per_sample", flops_per_sample=fps,
+                          note="profile_breakdown analytic (ops.flops)")
     n_params = sum(int(np.prod(np.shape(l)))
                    for l in jax.tree_util.tree_leaves(host_params))
 
@@ -213,6 +218,8 @@ def main() -> int:
         "ideal_matmul_us_at_peak": round(ideal_us, 2) if ideal_us else None,
         "mfu": (fps * gb / (results["full_step"] * peak)) if peak else None,
         "n_params": n_params,
+        # both methodologies, provenance-stamped (costs.py)
+        "cost_registry": costs.registry(),
     }
     log("")
     log(f"breakdown (us/step, batch {gb}, {device_kind}):")
@@ -223,11 +230,14 @@ def main() -> int:
             f"(analytic FLOPs / {peak / 1e12:.0f} TF/s)")
         log(f"  MFU {out['mfu'] * 100:.1f}%")
 
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "PROFILE_BREAKDOWN.json")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "PROFILE_BREAKDOWN.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
     log(f"wrote {path}")
+    saved = costs.save(root)
+    if saved:
+        log(f"wrote {saved}")
     print(json.dumps(out["breakdown_us"]))
     return 0
 

@@ -69,9 +69,13 @@ def main() -> int:
 
     tmpdir = tempfile.mkdtemp(prefix="dpt_trace_")
     jax.profiler.start_trace(tmpdir)
-    st, m = compiled(st, loader.images, loader.labels, idx, valid, key)
-    jax.block_until_ready(m["loss"])
-    jax.profiler.stop_trace()
+    try:
+        st, m = compiled(st, loader.images, loader.labels, idx, valid,
+                         key)
+        jax.block_until_ready(m["loss"])
+    finally:
+        # a raised dispatch must not leak a running global profiler
+        jax.profiler.stop_trace()
 
     files = glob.glob(os.path.join(
         tmpdir, "**", "*.trace.json.gz"), recursive=True)

@@ -5,10 +5,11 @@ retry telemetry trail, and the zero-cost-when-disabled contract."""
 
 import json
 import os
+import time
 
 import pytest
 
-from distributedpytorch_tpu import faults, telemetry
+from distributedpytorch_tpu import faults, flightrec, telemetry
 
 
 @pytest.fixture(autouse=True)
@@ -20,6 +21,7 @@ def clean_plan():
     yield
     faults.install(None)
     telemetry._active = telemetry.Telemetry(enabled=False)
+    flightrec._active = flightrec.FlightRecorder(enabled=False)
 
 
 # -- plan parsing ------------------------------------------------------
@@ -109,6 +111,37 @@ def test_torn_kind_truncates_file_and_continues(tmp_path):
     faults.install(faults.parse_plan("ckpt.finalize:torn:0"))
     faults.fire("ckpt.finalize", path=str(victim))  # must NOT raise
     assert victim.stat().st_size == 500
+
+
+def test_stall_kind_sleeps_and_continues():
+    faults.install(faults.parse_plan("data.host_batch:stall:0:1:0.2"))
+    t0 = time.perf_counter()
+    faults.fire("data.host_batch")  # must NOT raise — it's a straggler
+    assert time.perf_counter() - t0 >= 0.2
+    t0 = time.perf_counter()
+    faults.fire("data.host_batch")  # past the window: instant
+    assert time.perf_counter() - t0 < 0.1
+
+
+def test_stall_dsl_default_duration():
+    plan = faults.parse_plan("data.host_batch:stall:3")
+    assert plan.specs[0].kind == "stall"
+    assert plan.specs[0].stall_s == pytest.approx(0.25)
+    with pytest.raises(ValueError, match="stall_s"):
+        faults.FaultSpec(site="data.read", kind="stall", stall_s=0.0)
+
+
+def test_fault_firing_lands_in_flight_recorder(tmp_path):
+    rec = flightrec.configure(str(tmp_path), True)
+    faults.install(faults.parse_plan("data.read:ioerror:0:1"))
+    with pytest.raises(faults.InjectedIOError):
+        faults.fire("data.read")
+    events = [r for r in rec._ring if r.get("kind") == "event"]
+    assert [e["name"] for e in events] == ["fault_injected"]
+    assert events[0]["site"] == "data.read"
+    # the injected kind rides along as "fault_kind" — it must not
+    # clobber the record schema's reserved "kind" field
+    assert events[0]["fault_kind"] == "ioerror"
 
 
 def test_path_match_filters_hits(tmp_path):

@@ -1,0 +1,231 @@
+"""Flight recorder + anomaly detector (flightrec.py): ring bounds, dump
+format and reasons, the disabled no-op contract, detector triggers
+(step-time, starvation, retry-burst) with the bounded capture state
+machine (profiler calls monkeypatched — no real traces), and the
+observe_step wiring that lands ``anomaly`` on both sinks."""
+
+import json
+import os
+
+import jax
+import pytest
+
+from distributedpytorch_tpu import flightrec, telemetry
+
+
+@pytest.fixture(autouse=True)
+def clean_singletons():
+    yield
+    flightrec._active = flightrec.FlightRecorder(enabled=False)
+    telemetry._active = telemetry.Telemetry(enabled=False)
+
+
+@pytest.fixture
+def profiler_calls(monkeypatch):
+    """Count (and neuter) the programmatic profiler entry points."""
+    calls = {"start": [], "stop": 0}
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda path, **kw: calls["start"].append(path))
+
+    def _stop():
+        calls["stop"] += 1
+
+    monkeypatch.setattr(jax.profiler, "stop_trace", _stop)
+    return calls
+
+
+def _detector(tmp_path, **kw):
+    kw.setdefault("window", 4)
+    kw.setdefault("min_excess_s", 0.05)
+    return flightrec.AnomalyDetector(
+        trace_dir=str(tmp_path / "traces"), **kw)
+
+
+def _fill(det, n=None, value=0.01):
+    for i in range(det.window if n is None else n):
+        assert det.observe_step(epoch=0, step=i, step_s=value) is None
+
+
+# -- recorder ----------------------------------------------------------
+
+
+def test_ring_is_bounded_and_dump_has_contract(tmp_path):
+    rec = flightrec.FlightRecorder(enabled=True, rsl_path=str(tmp_path),
+                                   rank=3, ring_size=16)
+    for i in range(50):
+        rec.record_step(epoch=0, step=i, step_s=0.01, dispatch_s=0.004,
+                        wait_s=0.001, queue_depth=2)
+    assert len(rec._ring) == 16  # fixed memory: oldest evicted
+    path = rec.dump("on_demand")
+    assert path == str(tmp_path / "flightrec-rank3.json")
+    doc = json.loads(open(path).read())
+    assert doc["rank"] == 3 and doc["ring_size"] == 16
+    assert doc["reason"] == "on_demand"
+    assert doc["reasons"] == ["on_demand"]
+    assert set(doc["dumped_at"]) == {"ts", "mono"}
+    assert len(doc["records"]) == 16
+    first = doc["records"][0]
+    # every record carries the paired-stamp contract + step payload
+    assert {"ts", "mono", "step_s", "dispatch_s", "wait_s",
+            "queue_depth"} <= set(first)
+    assert first["step"] == 34  # 50 - 16: the ring kept the newest
+
+
+def test_dump_reasons_accumulate_and_close_disables(tmp_path):
+    rec = flightrec.FlightRecorder(enabled=True, rsl_path=str(tmp_path))
+    rec.record_event("preempt_signal", signum=15)
+    rec.dump("preempt_signal")
+    rec.close("run_end")
+    doc = json.loads(open(tmp_path / "flightrec-rank0.json").read())
+    assert doc["reasons"] == ["preempt_signal", "run_end"]
+    assert not rec.enabled
+    rec.record_step(epoch=0, step=0, step_s=1.0)  # no-op after close
+    assert doc["records"] == json.loads(
+        open(tmp_path / "flightrec-rank0.json").read())["records"]
+
+
+def test_disabled_recorder_touches_nothing(tmp_path):
+    rec = flightrec.FlightRecorder(enabled=False, rsl_path=str(tmp_path))
+    rec.record_step(epoch=0, step=0, step_s=1.0)
+    rec.record_event("retry", site="data.read")
+    assert rec.dump("whatever") is None
+    rec.close()
+    assert os.listdir(tmp_path) == []
+
+
+def test_configure_closes_previous_instance(tmp_path):
+    first = flightrec.configure(str(tmp_path), True, rank=0)
+    first.record_step(epoch=0, step=0, step_s=0.5)
+    flightrec.configure(str(tmp_path), True, rank=0)
+    doc = json.loads(open(tmp_path / "flightrec-rank0.json").read())
+    assert doc["reason"] == "reconfigure"
+    assert not first.enabled
+
+
+def test_load_dumps_skips_torn_files(tmp_path):
+    rec = flightrec.FlightRecorder(enabled=True, rsl_path=str(tmp_path),
+                                   rank=1)
+    rec.record_step(epoch=0, step=0, step_s=0.1)
+    rec.dump("run_end")
+    (tmp_path / "flightrec-rank2.json").write_text('{"rank": 2, "rec')
+    dumps = flightrec.load_dumps(str(tmp_path))
+    assert sorted(dumps) == [1]  # the torn rank-2 dump is skipped
+
+
+# -- anomaly detector triggers ----------------------------------------
+
+
+def test_no_judging_until_window_full(tmp_path, profiler_calls):
+    det = _detector(tmp_path)
+    # A huge outlier among the first `window` steps must NOT trigger:
+    # the baseline would include compile steps.
+    assert det.observe_step(epoch=0, step=0, step_s=60.0) is None
+    assert det.anomalies == 0 and not profiler_calls["start"]
+
+
+def test_step_time_trigger_fires_once_window_full(tmp_path,
+                                                  profiler_calls):
+    det = _detector(tmp_path)
+    _fill(det)
+    assert det.observe_step(epoch=0, step=9, step_s=0.5) == "step_time"
+    assert det.anomalies == 1
+    assert profiler_calls["start"] == [
+        str(tmp_path / "traces" / "capture-0")]
+
+
+def test_small_jitter_never_triggers(tmp_path, profiler_calls):
+    # Excess below the absolute min_excess_s floor: micro-jitter on
+    # millisecond steps stays silent even at 5x the median.
+    det = _detector(tmp_path, rel_factor=3.0)
+    _fill(det, value=0.005)
+    for step_s in (0.006, 0.009, 0.025):
+        assert det.observe_step(epoch=0, step=9, step_s=step_s) is None
+    assert det.anomalies == 0 and not profiler_calls["start"]
+
+
+def test_starvation_trigger(tmp_path, profiler_calls):
+    det = _detector(tmp_path)
+    _fill(det)
+    got = det.observe_step(epoch=0, step=9, step_s=0.02, wait_s=0.3)
+    assert got == "starvation"
+
+
+def test_retry_burst_trigger_needs_no_window(tmp_path, profiler_calls):
+    det = _detector(tmp_path, retry_burst=3)
+    for _ in range(3):
+        det.note_retry()
+    assert det.observe_step(epoch=0, step=0, step_s=0.01) == "retry_burst"
+    # counted retries reset after each observed step
+    det.note_retry()
+    assert det.observe_step(epoch=0, step=1, step_s=0.01) is None
+
+
+def test_capture_runs_k_steps_then_stops(tmp_path, profiler_calls):
+    det = _detector(tmp_path, capture_steps=2)
+    _fill(det)
+    det.observe_step(epoch=0, step=9, step_s=0.5)
+    assert profiler_calls["stop"] == 0
+    # the anomalous region is not re-judged into more captures
+    det.observe_step(epoch=0, step=10, step_s=0.9)
+    assert profiler_calls["stop"] == 0 and det.anomalies == 1
+    det.observe_step(epoch=0, step=11, step_s=0.9)
+    assert profiler_calls["stop"] == 1  # budget exhausted -> stop_trace
+
+
+def test_capture_budget_is_bounded(tmp_path, profiler_calls):
+    # mad_k=0 so the absolute-excess arm is just min_excess_s: spikes
+    # interleaved with normal steps (which restore the window median)
+    # re-trigger reliably, and only the capture budget limits us.
+    det = _detector(tmp_path, capture_steps=1, max_captures=2,
+                    mad_k=0.0, rel_factor=1.5)
+    _fill(det)
+    anomalies = 0
+    for step in range(6):  # 50.0, 0.01, 50.0, 0.01, 50.0, 0.01
+        got = det.observe_step(epoch=0, step=step,
+                               step_s=50.0 if step % 2 == 0 else 0.01)
+        anomalies += got is not None
+    assert anomalies == 3          # every spike is still *detected*...
+    assert det.captures_started == 2  # ...but only 2 captures started
+    assert len(profiler_calls["start"]) == 2
+    assert profiler_calls["stop"] == 2
+
+
+def test_close_stops_inflight_capture(tmp_path, profiler_calls):
+    det = _detector(tmp_path, capture_steps=10)
+    _fill(det)
+    det.observe_step(epoch=0, step=9, step_s=0.5)  # capture starts
+    det.close()
+    assert profiler_calls["stop"] == 1
+    det.close()  # idempotent: nothing in flight anymore
+    assert profiler_calls["stop"] == 1
+
+
+# -- observe_step wiring ----------------------------------------------
+
+
+def test_observe_step_emits_anomaly_on_both_sinks(tmp_path,
+                                                  profiler_calls):
+    tel = telemetry.configure(str(tmp_path), True)
+    rec = flightrec.configure(str(tmp_path), True)
+    det = flightrec.attach_detector(rec, trace_dir=str(tmp_path / "t"),
+                                    window=4, retry_burst=1)
+    assert det is not None
+    rec.record_event("retry", site="data.read", attempt=1)  # feeds burst
+    flightrec.observe_step(rec, epoch=2, step=7, step_s=0.01)
+    ring_names = [r.get("name") for r in rec._ring
+                  if r.get("kind") == "event"]
+    assert "anomaly" in ring_names
+    tel.close()
+    ev = [json.loads(line) for line in
+          open(tmp_path / "telemetry" / "rank0.jsonl")]
+    anoms = [e for e in ev if e.get("kind") == "event"
+             and e.get("name") == "anomaly"]
+    assert len(anoms) == 1
+    assert anoms[0]["attrs"]["trigger"] == "retry_burst"
+    assert anoms[0]["attrs"]["epoch"] == 2
+
+
+def test_attach_detector_refuses_disabled_recorder(tmp_path):
+    rec = flightrec.FlightRecorder(enabled=False)
+    assert flightrec.attach_detector(
+        rec, trace_dir=str(tmp_path)) is None

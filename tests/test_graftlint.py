@@ -538,6 +538,71 @@ def test_retry_without_backoff_negative(tmp_path):
                  rule="retry-without-backoff") == []
 
 
+# -- rule 10: profiler-trace-leak --------------------------------------
+
+def test_profiler_trace_leak_positive(tmp_path):
+    src = """
+        import jax
+
+        def profile_epoch(run, path):
+            jax.profiler.start_trace(path)
+            run()                          # raising run() leaks: BAD
+            jax.profiler.stop_trace()
+
+        def profile_early_return(run, path, skip):
+            jax.profiler.start_trace(path)
+            if skip:
+                return None                # leaks on this path: BAD
+            run()
+            jax.profiler.stop_trace()
+    """
+    found = _lint(tmp_path, {"mod.py": src}, rule="profiler-trace-leak")
+    assert len(found) == 2
+    assert all("finally" in f.message for f in found)
+
+
+def test_profiler_trace_leak_finally_negative(tmp_path):
+    src = """
+        import jax
+
+        def profile_epoch(run, path):
+            jax.profiler.start_trace(path)
+            try:
+                run()
+            finally:
+                jax.profiler.stop_trace()  # every path stops: fine
+    """
+    assert _lint(tmp_path, {"mod.py": src},
+                 rule="profiler-trace-leak") == []
+
+
+def test_profiler_trace_leak_class_close_negative(tmp_path):
+    # The split start/stop state machine (flightrec.AnomalyDetector):
+    # one method starts, another stops K steps later, and close() owns
+    # the finally that guarantees no capture outlives the object.
+    src = """
+        import jax
+
+        class Capturer:
+            def start(self, path):
+                jax.profiler.start_trace(path)
+                self.live = True
+
+            def step(self):
+                if self.live:
+                    self.live = False
+                    jax.profiler.stop_trace()
+
+            def close(self):
+                try:
+                    self.live = False
+                finally:
+                    jax.profiler.stop_trace()
+    """
+    assert _lint(tmp_path, {"mod.py": src},
+                 rule="profiler-trace-leak") == []
+
+
 # -- suppressions ------------------------------------------------------
 
 def test_suppression_with_rationale_silences(tmp_path):

@@ -43,6 +43,7 @@ def test_counter_gauge_histogram_semantics(tmp_path):
     assert s["count"] == 100 and s["min"] == 0.0 and s["max"] == 99.0
     assert s["mean"] == pytest.approx(49.5)
     assert s["p50"] == pytest.approx(50.0, abs=2)
+    assert s["p95"] == pytest.approx(95.0, abs=2)
     assert s["p99"] == pytest.approx(99.0, abs=2)
 
     tel.gauge("g").set(1.25, epoch=7)
@@ -53,8 +54,11 @@ def test_counter_gauge_histogram_semantics(tmp_path):
     assert by_kind[("gauge", "g")]["value"] == 1.25
     assert by_kind[("gauge", "g")]["attrs"] == {"epoch": 7}
     assert by_kind[("histogram", "h")]["count"] == 100
-    # every line carries the rank and a timestamp
+    # every line carries the rank and the paired-stamp contract: wall
+    # time for humans, monotonic for cross-record arithmetic
     assert all(e["rank"] == 3 and e["ts"] > 0 for e in events)
+    assert all(isinstance(e["mono"], float) and e["mono"] > 0
+               for e in events)
 
 
 def test_disabled_instance_does_no_file_io(tmp_path):
@@ -107,6 +111,10 @@ def test_span_nesting_and_schema_roundtrip(tmp_path):
     # inner closed first, and durations nest
     assert spans["inner"]["dur_s"] <= spans["outer"]["dur_s"]
     assert spans["inner"]["attrs"] == {"step": 4}
+    # span stamps are END stamps: start = mono - dur_s, so inner's
+    # reconstructed start can't precede outer's
+    assert (spans["inner"]["mono"] - spans["inner"]["dur_s"]
+            >= spans["outer"]["mono"] - spans["outer"]["dur_s"])
     # the aggregate of a round-tripped file sees both spans
     agg = telemetry.aggregate(events)
     assert agg["spans"]["outer"]["count"] == 1

@@ -1,0 +1,229 @@
+"""Cross-rank timeline merger (timeline.py): health-boundary clock
+alignment across ranks with disjoint mono origins and skewed wall
+clocks, the wall-clock fallback, the Chrome trace-event contract
+(non-negative ts, per-rank ordering, metadata rows), skew + straggler
+reporting, and the hostile inputs the CLI must degrade on — missing
+rank dump, torn JSONL tail, no telemetry at all."""
+
+import json
+import os
+
+import pytest
+
+from distributedpytorch_tpu import timeline
+
+# Synthetic physical timeline: both ranks live through the same real
+# instants T, but each stamps them with its own clocks.  Rank 1's mono
+# origin is 4000s away from rank 0's (fresh process) and its wall clock
+# runs 0.25s ahead (host skew) — exactly what alignment must undo.
+_WALL0 = 1.7e9
+_MONO0 = 1000.0
+_MONO1 = 5000.0
+_SKEW1 = 0.25
+
+
+def _stamp(rank, t):
+    if rank == 0:
+        return {"ts": _WALL0 + t, "mono": _MONO0 + t, "rank": 0}
+    return {"ts": _WALL0 + t + _SKEW1, "mono": _MONO1 + t, "rank": 1}
+
+
+def _span(rank, name, end_t, dur_s, **attrs):
+    ev = {"kind": "span", "name": name, "dur_s": dur_s, **_stamp(rank, end_t)}
+    if attrs:
+        ev["attrs"] = attrs
+    return ev
+
+
+def _event(rank, name, t, **attrs):
+    ev = {"kind": "event", "name": name, **_stamp(rank, t)}
+    if attrs:
+        ev["attrs"] = attrs
+    return ev
+
+
+def _write_rank(rsl, rank, events):
+    tdir = os.path.join(rsl, "telemetry")
+    os.makedirs(tdir, exist_ok=True)
+    with open(os.path.join(tdir, f"rank{rank}.jsonl"), "a") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+
+
+def _write_dump(rsl, rank, records, reason="run_end"):
+    doc = {"rank": rank, "ring_size": 64, "reason": reason,
+           "reasons": [reason],
+           "dumped_at": _stamp(rank, 9.0), "records": records}
+    with open(os.path.join(rsl, f"flightrec-rank{rank}.json"), "w") as f:
+        f.write(json.dumps(doc))
+
+
+def _step(rank, step, end_t, step_s, wait_s=None):
+    rec = {"kind": "step", "epoch": 0, "step": step, "step_s": step_s,
+           **_stamp(rank, end_t)}
+    del rec["rank"]  # flight records carry rank at the dump level
+    if wait_s is not None:
+        rec["wait_s"] = wait_s
+    return rec
+
+
+def _two_rank_run(rsl):
+    """Two epochs, health boundaries at T=2 and T=4 on both ranks;
+    rank 1 is the straggler (slower epochs).  Rank 0 also has a flight
+    record with a heavy data-wait share."""
+    for rank in (0, 1):
+        slow = 0.05 * rank
+        _write_rank(rsl, rank, [
+            _span(rank, "epoch", 2.0, 1.9 + slow, epoch=0),
+            _event(rank, "health_boundary", 2.0, epoch=0),
+            _span(rank, "epoch", 4.0, 1.9 + slow, epoch=1),
+            _event(rank, "health_boundary", 4.0, epoch=1),
+        ])
+    _write_dump(rsl, 0, [
+        _step(0, 0, 0.5, step_s=0.1, wait_s=0.06),
+        _step(0, 1, 0.7, step_s=0.1, wait_s=0.06),
+    ])
+    return rsl
+
+
+# -- hostile inputs ----------------------------------------------------
+
+
+def test_no_telemetry_at_all_is_actionable(tmp_path):
+    with pytest.raises(ValueError, match="telemetry"):
+        timeline.build_timeline(str(tmp_path))
+
+
+def test_no_rank_stamped_events_is_actionable(tmp_path):
+    # Old-build telemetry: records exist but none carry a rank stamp.
+    tdir = tmp_path / "telemetry"
+    tdir.mkdir()
+    (tdir / "rank0.jsonl").write_text(
+        '{"kind": "event", "name": "x", "ts": 1.0, "mono": 1.0}\n')
+    with pytest.raises(ValueError, match="rank-stamped"):
+        timeline.build_timeline(str(tmp_path))
+
+
+def test_torn_jsonl_tail_is_skipped(tmp_path):
+    rsl = _two_rank_run(str(tmp_path))
+    with open(os.path.join(rsl, "telemetry", "rank0.jsonl"), "a") as f:
+        f.write('{"kind": "event", "name": "anomaly", "ts": 1.7')  # torn
+    result = timeline.build_timeline(rsl)
+    assert result["ranks"] == [0, 1]  # the torn line cost nothing else
+    assert result["alignment"] == "health_boundary"
+
+
+def test_missing_rank_dump_degrades_with_warning(tmp_path):
+    rsl = _two_rank_run(str(tmp_path))  # rank 1 has no flight record
+    result = timeline.build_timeline(rsl)
+    assert any("flightrec-rank1.json" in w for w in result["warnings"])
+    # rank 1 still contributes its telemetry spans to the trace
+    assert any(e.get("pid") == 1 and e["ph"] == "X"
+               for e in result["trace"]["traceEvents"])
+
+
+# -- clock alignment ---------------------------------------------------
+
+
+def test_two_rank_alignment_via_health_boundary(tmp_path):
+    result = timeline.build_timeline(_two_rank_run(str(tmp_path)))
+    assert result["alignment"] == "health_boundary"
+    # The boundary instants name the same physical moment, so after
+    # alignment the two ranks' instants coincide despite mono origins
+    # 4000s apart and 0.25s of wall skew.
+    instants = {e["pid"]: e["ts"]
+                for e in result["trace"]["traceEvents"]
+                if e["ph"] == "i" and e["name"] == "health_boundary"
+                and e["args"].get("epoch") == 0}
+    assert set(instants) == {0, 1}
+    assert instants[0] == pytest.approx(instants[1], abs=1.0)  # µs
+
+
+def test_wall_clock_skew_is_reported(tmp_path):
+    result = timeline.build_timeline(_two_rank_run(str(tmp_path)))
+    skew = result["skew"]
+    assert skew["boundary_epochs"] == [0, 1]
+    assert skew["max_wall_skew_s"] == pytest.approx(_SKEW1, abs=1e-6)
+    assert skew["wall_skew_s_per_epoch"]["0"] == pytest.approx(
+        _SKEW1, abs=1e-6)
+
+
+def test_single_rank_falls_back_to_wall_clock(tmp_path):
+    rsl = str(tmp_path)
+    _write_rank(rsl, 0, [
+        _span(0, "epoch", 2.0, 1.9, epoch=0),
+        _event(0, "health_boundary", 2.0, epoch=0),
+    ])
+    result = timeline.build_timeline(rsl)
+    assert result["alignment"] == "wall_clock"
+    assert result["skew"]["max_wall_skew_s"] is None  # needs >= 2 ranks
+
+
+def test_unshared_boundaries_fall_back_with_warning(tmp_path):
+    rsl = str(tmp_path)
+    _write_rank(rsl, 0, [_span(0, "epoch", 2.0, 1.9, epoch=0),
+                         _event(0, "health_boundary", 2.0, epoch=0)])
+    # rank 1 never reached a health boundary (crashed mid-epoch)
+    _write_rank(rsl, 1, [_span(1, "epoch", 2.1, 2.0, epoch=0)])
+    result = timeline.build_timeline(rsl)
+    assert result["alignment"] == "wall_clock"
+    assert any("health_boundary" in w for w in result["warnings"])
+
+
+# -- trace contract ----------------------------------------------------
+
+
+def test_trace_event_contract(tmp_path):
+    result = timeline.build_timeline(_two_rank_run(str(tmp_path)))
+    trace = result["trace"]
+    assert trace["displayTimeUnit"] == "ms"
+    assert trace["otherData"]["ranks"] == [0, 1]
+    events = trace["traceEvents"]
+    assert {e.get("pid") for e in events} == {0, 1}
+    for pid in (0, 1):
+        per = [e for e in events if e.get("pid") == pid]
+        meta = [e for e in per if e["ph"] == "M"]
+        rest = [e for e in per if e["ph"] != "M"]
+        # metadata rows lead; the rest is time-ordered and non-negative
+        assert per[:len(meta)] == meta
+        assert {m["name"] for m in meta} >= {"process_name", "thread_name"}
+        ts = [e["ts"] for e in rest]
+        assert all(t >= 0 for t in ts)
+        assert ts == sorted(ts)
+    for e in events:
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+        elif e["ph"] == "i":
+            assert e["s"] == "p"
+    # rank 0's flight-record steps landed on their own thread row
+    assert any(e["ph"] == "X" and e.get("cat") == "flightrec"
+               and e["pid"] == 0 for e in events)
+
+
+def test_straggler_attribution(tmp_path):
+    result = timeline.build_timeline(_two_rank_run(str(tmp_path)))
+    rows = {row["rank"]: row for row in result["stragglers"]}
+    assert rows[1].get("straggler") is True  # slower mean epoch
+    assert "straggler" not in rows[0]
+    assert rows[0]["steps_recorded"] == 2
+    assert rows[0]["data_wait_share"] == pytest.approx(0.6, abs=1e-6)
+    assert rows[1]["mean_step_s"] is None  # no flight record for rank 1
+
+
+# -- CLI surface -------------------------------------------------------
+
+
+def test_write_timeline_and_summary(tmp_path):
+    rsl = _two_rank_run(str(tmp_path))
+    path, result = timeline.write_timeline(rsl)
+    assert path == os.path.join(rsl, "timeline.json")
+    trace = json.loads(open(path).read())  # valid JSON on disk
+    assert trace["traceEvents"]
+    summary = timeline.render_summary(result, path)
+    assert "health_boundary" in summary
+    assert "skew" in summary
+    assert "<- straggler" in summary
+    # --out redirects the trace file
+    other = str(tmp_path / "elsewhere.json")
+    assert timeline.write_timeline(rsl, out=other)[0] == other
+    assert json.loads(open(other).read())["traceEvents"]
