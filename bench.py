@@ -19,7 +19,9 @@ CNN number; diagnostics on stderr.  Extra modes:
 
 MFU: FLOPs come from the analytic model count (ops/flops.py: jaxpr walk
 over the forward pass, train = 3x forward — the convention every published
-MFU number uses); peak is the chip's published bf16 rate.  The TPU
+MFU number uses); the peak denominator is dtype-aware (ops/flops.py
+per-dtype table: bf16 runs divide by the chip's published bf16 rate, f32
+runs by the f32 rate) and every row records ``mfu_peak_dtype``.  The TPU
 executable's own cost_analysis() undercounts by orders of magnitude
 (post-fusion per-partition estimates) and is recorded only as the
 ``xla_reported_flops_total`` cross-check field.
@@ -48,13 +50,15 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def peak_flops(device_kind: str) -> float | None:
+def peak_flops(device_kind: str, dtype: str = "bf16") -> float | None:
     # Single source of truth for the peak table: ops/flops.py (shared
     # with the telemetry MFU gauge).  Imported lazily — bench.py sets up
-    # the platform before importing the framework.
+    # the platform before importing the framework.  ``dtype`` selects the
+    # denominator (honest MFU: a bf16 run divides by the bf16 peak, an
+    # f32 run by the f32 peak — ops/flops.py documents the convention).
     from distributedpytorch_tpu.ops.flops import peak_flops as _pf
 
-    return _pf(device_kind)
+    return _pf(device_kind, dtype)
 
 
 def _force_sync_timing_mode() -> None:
@@ -92,21 +96,25 @@ def bench_ours(batch_per_replica: int, steps: int, model_name: str,
                image_size: int = 28, channels: int = 1,
                num_train: int = 60000, epochs_fused: int = 12,
                half_precision: bool = True, moe_experts: int = 0,
-               pallas_dw: bool = False) -> dict:
+               pallas_dw: bool = False, precision: str | None = None,
+               remat: str = "none") -> dict:
     import jax
 
     from distributedpytorch_tpu import runtime, utils
     from distributedpytorch_tpu.data.pipeline import ResidentLoader
     from distributedpytorch_tpu.models import get_model, get_model_input_size
     from distributedpytorch_tpu.ops.losses import get_loss_fn
+    from distributedpytorch_tpu.precision import from_flags
     from distributedpytorch_tpu.train.engine import Engine, make_optimizer
 
     mesh = runtime.make_mesh()
     n_chips = runtime.world_size()
     device_kind = jax.devices()[0].device_kind
+    policy = from_flags(precision, half_precision)
     log(f"devices: {n_chips} x {device_kind} | model {model_name} "
         f"batch {batch_per_replica}/replica corpus "
-        f"{image_size}x{image_size}x{channels}")
+        f"{image_size}x{image_size}x{channels} precision {policy.name}"
+        + (f" remat {remat}" if remat != "none" else ""))
 
     dataset = _make_corpus(image_size, channels, num_train)
     # Device-resident mode (the framework's default for HBM-sized corpora):
@@ -114,14 +122,14 @@ def bench_ours(batch_per_replica: int, steps: int, model_name: str,
     loader = ResidentLoader(dataset.splits["train"], mesh, batch_per_replica,
                             shuffle=True, seed=1234)
     model = get_model(model_name, dataset.nb_classes,
-                      half_precision=half_precision,
+                      precision=policy, remat=remat,
                       moe_experts=moe_experts, mesh=mesh,
                       pallas_dw=pallas_dw)
     tx = make_optimizer("adam", 1e-3, 0.9, 0.1, len(loader), False)
     engine = Engine(model, model_name, get_loss_fn("cross_entropy"), tx,
                     dataset.mean, dataset.std,
                     get_model_input_size(model_name),
-                    half_precision=half_precision)
+                    precision=policy, remat=remat)
     state = jax.device_put(
         engine.init_state(utils.root_key(1234)),
         runtime.replicated_sharding(mesh))
@@ -199,7 +207,15 @@ def bench_ours(batch_per_replica: int, steps: int, model_name: str,
            "n_chips": n_chips, "global_batch": global_batch,
            "steps": n_steps, "elapsed_s": elapsed,
            "device_kind": device_kind, "mfu": None}
-    peak = peak_flops(device_kind)
+    # Honest MFU: the denominator matches the run's compute dtype
+    # (ops/flops.py per-dtype peak table), and the row records WHICH
+    # peak it divided by so the number is auditable.
+    peak_dtype = flops_mod.dtype_label(engine.compute_dtype)
+    peak = peak_flops(device_kind, peak_dtype)
+    out["precision"] = policy.name
+    out["remat"] = remat
+    out["mfu_peak_dtype"] = peak_dtype
+    out["mfu_peak_flops_per_chip"] = peak
     out["flops_per_sample"] = flops_per_sample
     out["flops_per_step"] = flops_total / n_steps
     out["xla_reported_flops_total"] = xla_flops
@@ -802,6 +818,7 @@ def _fallback_headline() -> dict | None:
                 "vs_baseline": None,
                 "mfu": (round(row["mfu"], 4) if row.get("mfu")
                         else None),
+                "mfu_peak_dtype": row.get("mfu_peak_dtype"),
                 "error": "TPU backend unavailable at run time "
                          "(tunnel down); value is the last on-chip "
                          "measurement committed in BENCH_SUITE.json "
@@ -920,6 +937,7 @@ def main() -> int:
         "fresh": True,
         "vs_baseline": round(vs, 2) if vs is not None else None,
         "mfu": (round(ours["mfu"], 4) if ours.get("mfu") else None),
+        "mfu_peak_dtype": ours.get("mfu_peak_dtype"),
     }), flush=True)
     return 0
 

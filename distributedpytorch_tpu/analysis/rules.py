@@ -1054,6 +1054,132 @@ class ProfilerTraceLeak(Rule):
                     f"try/finally: jax.profiler.stop_trace()")
 
 
+# -- 11. mixed-precision-accum -----------------------------------------
+
+_HALF_DTYPE_SEGS = {"bfloat16", "float16"}
+_HALF_DTYPE_STRINGS = {"bfloat16", "float16", "bf16", "f16"}
+
+
+class MixedPrecisionAccum(Rule):
+    """Accumulating in a half-precision dtype silently rots accuracy:
+    bf16 has ~8 mantissa bits, so a running sum loses every addend below
+    ~1/256 of the accumulator — loss curves drift, metrics saturate, and
+    nothing crashes.  The PrecisionPolicy contract (precision.py) keeps
+    params/compute in bf16 but ALL accumulation in f32; this rule flags
+    code that breaks it: a reduction asked to accumulate in a half dtype
+    (``jnp.sum(x, dtype=jnp.bfloat16)``), or a half-dtype accumulator
+    buffer (``acc = jnp.zeros(n, jnp.bfloat16)``) that is then summed
+    into in place or carried through ``lax.scan``.  Casting the RESULT
+    of an f32 reduction down is fine and is not flagged."""
+
+    name = "mixed-precision-accum"
+    description = ("reduction or running accumulator in a half dtype "
+                   "(bf16/f16) — accumulate in f32, cast the result")
+
+    _CREATORS = {"zeros", "ones", "full", "zeros_like", "ones_like",
+                 "full_like"}
+    _REDUCERS = {"sum", "mean", "average", "cumsum", "prod", "cumprod"}
+    _ACC_OPS = (ast.Add, ast.Sub, ast.Mult)
+
+    def _is_half_dtype(self, node: ast.expr) -> bool:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value in _HALF_DTYPE_STRINGS
+        return last_seg(dotted(node)) in _HALF_DTYPE_SEGS
+
+    def _creator_half_dtype(self, call: ast.Call) -> bool:
+        seg = last_seg(call_name(call))
+        if seg not in self._CREATORS:
+            return False
+        dt = kwarg(call, "dtype")
+        if dt is None:
+            # positional dtype: zeros/ones/*_like(x, dtype) at arg 1,
+            # full/full_like(shape, fill, dtype) at arg 2
+            pos = 2 if seg in ("full", "full_like") else 1
+            if len(call.args) > pos:
+                dt = call.args[pos]
+        return dt is not None and self._is_half_dtype(dt)
+
+    def _half_acc_vars(self, fn: ast.AST) -> Dict[str, int]:
+        """name -> creation line of half-dtype buffers assigned in fn."""
+        out: Dict[str, int] = {}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            pairs: List[Tuple[ast.expr, ast.expr]] = []
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    pairs.append((t, node.value))
+                elif isinstance(t, (ast.Tuple, ast.List)) \
+                        and isinstance(node.value, (ast.Tuple, ast.List)) \
+                        and len(t.elts) == len(node.value.elts):
+                    pairs.extend(zip(t.elts, node.value.elts))
+            for target, value in pairs:
+                if isinstance(target, ast.Name) \
+                        and isinstance(value, ast.Call) \
+                        and self._creator_half_dtype(value):
+                    out.setdefault(target.id, value.lineno)
+        return out
+
+    def _accumulations(self, fn: ast.AST, halfvars: Dict[str, int]
+                       ) -> Iterator[Tuple[int, str, str]]:
+        """(line, var, how) for each accumulation into a half buffer."""
+        for node in ast.walk(fn):
+            if isinstance(node, ast.AugAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.target.id in halfvars \
+                    and isinstance(node.op, self._ACC_OPS):
+                yield node.lineno, node.target.id, "augmented in place"
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id in halfvars \
+                            and t.id in names_in(node.value):
+                        yield (node.lineno, t.id,
+                               "rebound to an expression of itself")
+            elif isinstance(node, ast.Call) \
+                    and last_seg(call_name(node)) == "scan" \
+                    and len(node.args) >= 2:
+                carried = names_in(node.args[1]) & set(halfvars)
+                for var in sorted(carried):
+                    yield (node.lineno, var,
+                           "carried through lax.scan (summed every "
+                           "step)")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            # direct half-dtype reductions, anywhere in the module
+            for call in walk_calls(mod.tree):
+                if last_seg(call_name(call)) in self._REDUCERS:
+                    dt = kwarg(call, "dtype")
+                    if dt is not None and self._is_half_dtype(dt):
+                        yield self.finding(
+                            mod, call.lineno,
+                            f"{call_name(call)}(dtype=half) accumulates "
+                            f"in a half dtype — reduce in f32 (the "
+                            f"default) and cast the result instead")
+            # half-dtype accumulator buffers, per enclosing scope
+            scopes: List[ast.AST] = [mod.tree] + [
+                n for n in ast.walk(mod.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+            seen: Set[Tuple[int, str]] = set()
+            for scope in scopes:
+                halfvars = {
+                    k: v for k, v in self._half_acc_vars(scope).items()}
+                if not halfvars:
+                    continue
+                for line, var, how in self._accumulations(scope,
+                                                          halfvars):
+                    if (line, var) in seen:
+                        continue
+                    seen.add((line, var))
+                    yield self.finding(
+                        mod, line,
+                        f"half-dtype buffer {var!r} (created line "
+                        f"{halfvars[var]}) is {how}: bf16/f16 "
+                        f"accumulation drops addends below ~1/256 of "
+                        f"the running value — allocate the accumulator "
+                        f"in f32 and cast once at the end")
+
+
 RULES = (
     HostSyncInStepLoop(),
     TraceImpurity(),
@@ -1065,6 +1191,7 @@ RULES = (
     BareExcept(),
     RetryWithoutBackoff(),
     ProfilerTraceLeak(),
+    MixedPrecisionAccum(),
 )
 
 RULES_BY_NAME = {r.name: r for r in RULES}

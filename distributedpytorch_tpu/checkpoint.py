@@ -833,6 +833,16 @@ def _load_orbax(path: str, state: TrainState, restore_optimizer: bool
         restored_dict = vit_pipeline.convert_layout(restored_dict, dst)
     if not restore_optimizer:
         restored_dict["opt_state"] = template.get("opt_state", {})
+    # loss_scale compat — same shim as the msgpack path (see
+    # _load_checkpoint_inner): pre-field checkpoints get the template's
+    # value; a saved scale is dropped when the policy doesn't scale.
+    tmpl_ls = template.get("loss_scale")
+    if tmpl_ls is None:
+        restored_dict["loss_scale"] = None
+    elif restored_dict.get("loss_scale") is None:
+        # absent (pre-field) or saved as None (non-scaling policy wrote
+        # it): either way the template's fresh scale applies
+        restored_dict["loss_scale"] = tmpl_ls
     restored = serialization.from_state_dict(state, restored_dict)
     epoch = int(meta["epoch"]) + 1
     logging.info(f"epoch:{epoch:04d}: model loaded from {path}")
@@ -900,6 +910,19 @@ def _load_checkpoint_inner(path: str, state: TrainState,
     template_sd = serialization.to_state_dict(template)
     if not restore_optimizer:  # test path passes optimizer=None (ref :232)
         payload["state"]["opt_state"] = template_sd.get("opt_state", {})
+    # loss_scale compat (PrecisionPolicy): checkpoints written before the
+    # field existed have no entry — graft the template's (None for every
+    # preset but f16, a fresh LossScaleState for f16: the scale is a
+    # runtime adaption, losing it across restarts only costs a few
+    # re-adaptation steps).  And a scale saved by an f16 run restoring
+    # into a non-scaling policy is dropped the same way.
+    tmpl_ls = template_sd.get("loss_scale")
+    if tmpl_ls is None:
+        payload["state"]["loss_scale"] = None
+    elif payload["state"].get("loss_scale") is None:
+        # absent (pre-field checkpoint) or saved as None (non-scaling
+        # policy): either way the template's fresh scale applies
+        payload["state"]["loss_scale"] = tmpl_ls
     # A vit checkpoint serves both block layouts: PipelinedViT saves its
     # transformer params STACKED on (depth,); the plain ViT saves
     # per-block submodules.  When the saved layout differs from the

@@ -32,13 +32,15 @@ from .train.engine import Engine, make_optimizer
 
 def _build_engine(cfg: Config, model_name: str, dataset: Dataset,
                   steps_per_epoch: int, mesh=None) -> Engine:
+    policy = cfg.precision_policy()
     model = get_model(model_name, dataset.nb_classes,
                       half_precision=cfg.half_precision,
                       attention=cfg.attention, mesh=mesh,
                       tensor_parallel=cfg.tensor_parallel,
                       pipeline_parallel=cfg.pipeline_parallel,
                       pipeline_microbatches=cfg.pipeline_microbatches,
-                      moe_experts=cfg.moe_experts)
+                      moe_experts=cfg.moe_experts,
+                      precision=policy, remat=cfg.remat)
     # Working weighted/focal losses (fixes SURVEY defect #4).
     class_weights = (dataset.class_weights()
                      if cfg.loss in ("weighted_cross_entropy", "focal_loss")
@@ -49,8 +51,8 @@ def _build_engine(cfg: Config, model_name: str, dataset: Dataset,
                         cfg.feature_extract)
     return Engine(model, model_name, loss_fn, tx, dataset.mean, dataset.std,
                   get_model_input_size(model_name),
-                  half_precision=cfg.half_precision,
-                  grad_accum=cfg.grad_accum)
+                  grad_accum=cfg.grad_accum,
+                  precision=policy, remat=cfg.remat)
 
 
 def _place_state(state, mesh, cfg: Config):
@@ -85,6 +87,30 @@ def _resident_budget_bytes(cfg: Config) -> int:
     if hbm is not None:
         budget = min(budget, int(RESIDENT_HBM_FRACTION * hbm))
     return budget
+
+
+def _validate_precision(cfg: Config) -> None:
+    """Precision/remat knob validation, before any dataset/model cost.
+
+    Covers programmatic Config construction too (argparse already
+    restricts the CLI choices)."""
+    if cfg.precision is not None and cfg.precision not in (
+            "f32", "bf16", "bf16_full", "f16"):
+        raise ValueError(
+            f"--precision must be f32|bf16|bf16_full|f16, "
+            f"got {cfg.precision!r}")
+    if cfg.remat not in ("none", "blocks", "full"):
+        raise ValueError(
+            f"--remat must be none|blocks|full, got {cfg.remat!r}")
+    if cfg.precision not in (None, "f32") and not cfg.half_precision:
+        raise ValueError(
+            f"--no-bf16 conflicts with --precision {cfg.precision}: "
+            "--no-bf16 is the legacy alias for --precision f32; drop one")
+    if cfg.precision == "f16" and jax.default_backend() == "tpu":
+        raise ValueError(
+            "--precision f16 is for non-TPU backends only: the MXU has "
+            "no native f16 path (bf16 needs no loss scaling on TPU — "
+            "use --precision bf16)")
 
 
 def _validate_ckpt_format(cfg: Config) -> None:
@@ -158,30 +184,38 @@ def _make_loader(cfg: Config, split: Split, mesh, shuffle: bool):
 
 
 def _mfu_factors(engine: Engine) -> tuple:
-    """(flops_per_sample, peak_flops_per_chip) for the telemetry MFU
-    gauge — analytic model FLOPs (engine.init_state's jaxpr count) over
-    the chip's published bf16 peak.  Either may be None (untraceable
-    model / unknown device kind, e.g. CPU); the gauge is then omitted."""
-    from .ops.flops import peak_flops
+    """(flops_per_sample, peak_flops_per_chip, peak_dtype) for the
+    telemetry MFU gauge — analytic model FLOPs (engine.init_state's jaxpr
+    count) over the chip's peak AT THE RUN'S COMPUTE DTYPE (ops.flops
+    per-dtype table): a bf16 run divides by the bf16 peak, an f32 run by
+    the f32 peak, so MFU is never inflated by mismatched denominators.
+    flops/peak may be None (untraceable model / unknown device kind,
+    e.g. CPU); the gauge is then omitted."""
+    from .ops.flops import dtype_label, peak_flops
 
     fps = getattr(engine, "_flops_per_sample", None)
+    label = dtype_label(engine.compute_dtype)
     devs = jax.devices()
-    peak = peak_flops(devs[0].device_kind) if devs else None
-    return fps, peak
+    peak = peak_flops(devs[0].device_kind, label) if devs else None
+    return fps, peak, label
 
 
-def _record_throughput(tel, sps_chip: float, fps, peak, epoch: int) -> None:
+def _record_throughput(tel, sps_chip: float, fps, peak, epoch: int,
+                       peak_dtype: str = "bf16") -> None:
     """North-star gauges, per epoch: samples/s/chip always; MFU as a
-    fraction of the chip's bf16 peak when the model FLOPs and the peak
-    are both known, an explicit recorded null otherwise (CPU / unknown
-    device kind) so every run's JSONL documents the metric."""
+    fraction of the chip's per-dtype peak when the model FLOPs and the
+    peak are both known, an explicit recorded null otherwise (CPU /
+    unknown device kind) so every run's JSONL documents the metric.  The
+    denominator's dtype is recorded beside the value — an MFU number
+    without its peak dtype is unverifiable."""
     tel.gauge("throughput/samples_per_sec_per_chip").set(sps_chip,
                                                          epoch=epoch)
     if fps and peak:
-        tel.gauge("throughput/mfu").set(sps_chip * fps / peak, epoch=epoch)
+        tel.gauge("throughput/mfu").set(sps_chip * fps / peak, epoch=epoch,
+                                        peak_dtype=peak_dtype)
     else:
         tel.gauge("throughput/mfu").set(
-            None, epoch=epoch,
+            None, epoch=epoch, peak_dtype=peak_dtype,
             reason="unknown_peak" if fps else "unknown_model_flops")
 
 
@@ -274,6 +308,10 @@ def _aot_warmup(cfg: Config, engine: Engine, state, train_loader,
                               flops_per_sample=fps,
                               note="engine jaxpr count (ops.flops); "
                                    "x global_batch for per-step")
+    _, peak, pdt = _mfu_factors(engine)
+    if peak:
+        costs.record_mfu_denominator(peak, pdt,
+                                     jax.devices()[0].device_kind)
     if runtime.is_main():
         costs.save(cfg.rsl_path)
         logging.info(f"AOT warmup: train/eval programs compiled in "
@@ -419,7 +457,8 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
     """
     history = []
     tel = telemetry.get()
-    fps, peak = _mfu_factors(engine) if tel.enabled else (None, None)
+    fps, peak, pdt = (_mfu_factors(engine) if tel.enabled
+                      else (None, None, "bf16"))
     epoch = start_epoch
     while epoch < cfg.nb_epochs:
         chunk = list(range(epoch,
@@ -450,7 +489,8 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
             train_samples = len(train_loader) * train_loader.global_batch
             sps_chip = train_samples / max(per_epoch_s, 1e-9) / world
             if tel.enabled:
-                _record_throughput(tel, sps_chip, fps, peak, chunk[-1])
+                _record_throughput(tel, sps_chip, fps, peak, chunk[-1],
+                                   peak_dtype=pdt)
             chunk_improved = False
             for k, e in enumerate(chunk):
                 train_loss = float(np.mean(out["train_loss"][k]))
@@ -606,6 +646,7 @@ def run_train(cfg: Config) -> dict:
         raise ValueError(
             f"--grad-accum must be >= 1 and divide the per-replica batch "
             f"size ({cfg.batch_size}); got {cfg.grad_accum}")
+    _validate_precision(cfg)
     vit_features = (cfg.attention != "full" or cfg.tensor_parallel
                     or cfg.pipeline_parallel)
     # ring x pipeline is the one SUPPORTED composition (3-D mesh,
@@ -715,6 +756,12 @@ def run_train(cfg: Config) -> dict:
 
     engine = _build_engine(cfg, model_name, dataset, len(train_loader),
                            mesh=mesh)
+    # The resolved policy is part of the run's record: the precision gate
+    # (scripts/precision_gate.py) reads this event back to assert the
+    # accumulators really are f32 under the half-precision presets.
+    telemetry.get().event("precision_policy", remat=cfg.remat,
+                          grad_accum=cfg.grad_accum,
+                          **engine.precision.describe())
     root = utils.root_key(cfg.seed)
     state = engine.init_state(root)
 
@@ -838,7 +885,8 @@ def _run_train_epochs(cfg: Config, engine: Engine, state, train_loader,
     """The per-epoch driver loop (ref classif.py:151-192)."""
     history = []
     tel = telemetry.get()
-    fps, peak = _mfu_factors(engine) if tel.enabled else (None, None)
+    fps, peak, pdt = (_mfu_factors(engine) if tel.enabled
+                      else (None, None, "bf16"))
     for epoch in range(start_epoch, cfg.nb_epochs):
         if runtime.is_main():
             print(f"====================== epoch{epoch + 1:4d} "
@@ -878,7 +926,8 @@ def _run_train_epochs(cfg: Config, engine: Engine, state, train_loader,
             sps_chip = (train_samples
                         / max(train_end - epoch_start, 1e-9) / world)
             if tel.enabled:
-                _record_throughput(tel, sps_chip, fps, peak, epoch)
+                _record_throughput(tel, sps_chip, fps, peak, epoch,
+                                   peak_dtype=pdt)
 
             # Update best BEFORE any checkpoint write so the rolling file
             # carries the post-epoch best; saving it first would make a
@@ -954,6 +1003,8 @@ def run_test(cfg: Config) -> dict:
     faults.configure(cfg.fault_plan, cfg.fault_seed, cfg.retry_max_attempts,
                      cfg.retry_base_delay, cfg.retry_timeout)
     runtime.initialize_distributed()
+    # After distributed init: the f16-on-TPU check reads the backend.
+    _validate_precision(cfg)
     utils.initialize_logging(cfg.rsl_path, cfg.log_file,
                              truncate=runtime.is_main())
     tel = telemetry.configure(cfg.rsl_path, cfg.telemetry)

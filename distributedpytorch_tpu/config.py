@@ -107,6 +107,18 @@ class Config:
     # (compile/warmup_s + compile/cache_hit telemetry gauges).
     aot_warmup: bool = False
     half_precision: bool = True            # bfloat16 compute on TPU (MXU-native)
+    # Explicit mixed-precision preset (precision.PRESETS: f32 | bf16 |
+    # bf16_full | f16).  None derives the policy from the legacy
+    # half_precision bool (True -> bf16, False -> f32), so every
+    # programmatic Config(half_precision=...) construction keeps its exact
+    # historical behavior.  --precision and --no-bf16 conflict unless they
+    # agree (validated in cli.run_train/run_test).
+    precision: Optional[str] = None
+    # Gradient rematerialization: 'none' (default), 'blocks' (nn.remat at
+    # the zoo block boundaries — vit/densenet/inception — or a
+    # save-matmul-outputs jax.checkpoint around the whole apply for flat
+    # models), 'full' (checkpoint everything; max memory relief).
+    remat: str = "none"
     focal_gamma: float = 2.0               # ref utils.py:144
     # 'resident': split lives in HBM, one XLA dispatch per epoch;
     # 'stream': host batching + prefetch; 'auto' picks by size.
@@ -221,6 +233,12 @@ class Config:
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
 
+    def precision_policy(self):
+        """The resolved precision.PrecisionPolicy for this config."""
+        from .precision import from_flags
+
+        return from_flags(self.precision, self.half_precision)
+
     def compilation_cache_path(self) -> Optional[str]:
         """The effective persistent-cache dir: the explicit override, the
         RSL_PATH/xla_cache default, or None under --no-compile-cache."""
@@ -254,7 +272,25 @@ def _common_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--rsl_path", type=str, default=RSL_PATH,
                    help=f"results/checkpoint dir (default: {RSL_PATH})")
     p.add_argument("--no-bf16", action="store_true",
-                   help="disable bfloat16 compute (use float32)")
+                   help="disable bfloat16 compute (use float32; "
+                        "equivalent to --precision f32)")
+    p.add_argument("--precision",
+                   choices=("f32", "bf16", "bf16_full", "f16"),
+                   default=None,
+                   help="mixed-precision preset: f32 (all float32), bf16 "
+                        "(f32 master params, bfloat16 compute, f32 "
+                        "accumulation — the default behavior), bf16_full "
+                        "(bf16 master params too; halves param+optimizer "
+                        "memory), f16 (float16 compute with dynamic loss "
+                        "scaling; non-TPU backends only)")
+    p.add_argument("--remat", choices=("none", "blocks", "full"),
+                   default="none",
+                   help="gradient rematerialization: blocks = recompute "
+                        "each zoo block's interior in backward keeping "
+                        "matmul outputs (vit/densenet/inception blocks; "
+                        "whole-apply checkpoint for flat models), full = "
+                        "save nothing (max activation-memory relief; "
+                        "backward recomputes the forward)")
     p.add_argument("--data-mode", choices=("auto", "stream", "resident"),
                    default="auto", dest="dataMode",
                    help="device-resident vs streamed batches (default: auto)")
@@ -534,6 +570,8 @@ def config_from_argv(argv=None) -> Config:
         checkpoint_file=args.checkpointFile,
         debug=args.debug,
         half_precision=not args.no_bf16,
+        precision=args.precision,
+        remat=args.remat,
         data_mode=args.dataMode,
         prefetch=args.prefetch,
         producer_threads=args.producerThreads,

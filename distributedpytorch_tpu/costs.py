@@ -135,6 +135,30 @@ def record_analytic(name: str, *, flops: Optional[float] = None,
     return entry
 
 
+def record_mfu_denominator(peak: float, dtype: str,
+                           device_kind: Optional[str] = None) -> dict:
+    """Register WHICH peak-FLOPs denominator this run's MFU numbers use.
+
+    Honest-MFU bookkeeping (ops.flops per-dtype table): a bf16 run divides
+    by the bf16 peak, an f32 run by the f32 peak.  costs.json and the
+    telemetry stream both carry the record, so any MFU figure in bench/
+    telemetry output can be traced back to its denominator."""
+    entry = _stamp({
+        "source": "peak_table",
+        "peak_flops_per_chip": float(peak),
+        "peak_dtype": str(dtype),
+    })
+    if device_kind:
+        entry["device_kind"] = device_kind
+    with _lock:
+        _registry["mfu_denominator"] = entry
+    telemetry.get().event("cost_analysis", program="mfu_denominator",
+                          source=entry["source"],
+                          peak_flops_per_chip=entry["peak_flops_per_chip"],
+                          peak_dtype=entry["peak_dtype"])
+    return entry
+
+
 def registry() -> Dict[str, dict]:
     """Snapshot copy of the current registry (program name -> entry)."""
     with _lock:

@@ -11,6 +11,7 @@ import functools
 from typing import Any, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 
@@ -39,11 +40,23 @@ class DenseNet(nn.Module):
     num_init_features: int = 64
     num_classes: int = 10
     dtype: Any = jnp.bfloat16
+    # --remat blocks: recompute each DenseLayer's interior in backward.
+    # DenseNet is the zoo's worst activation hog (every layer's input is
+    # the concat of all earlier features), so this is the model the knob
+    # was built for.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         norm = functools.partial(nn.BatchNorm, use_running_average=not train,
                                  momentum=0.9, dtype=self.dtype)
+        layer_cls = DenseLayer
+        if self.remat:
+            # static_argnums=(2,): ``train`` (self is 0, x is 1).
+            layer_cls = nn.remat(
+                DenseLayer, static_argnums=(2,),
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        layer_idx = 0
         x = x.astype(self.dtype)
         x = nn.Conv(self.num_init_features, (7, 7), strides=(2, 2),
                     padding=[(3, 3), (3, 3)], use_bias=False,
@@ -52,7 +65,12 @@ class DenseNet(nn.Module):
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
         for i, n_layers in enumerate(self.block_config):
             for _ in range(n_layers):
-                x = DenseLayer(self.growth, self.bn_size, self.dtype)(x, train)
+                # Explicit name matching the historical auto-name, so the
+                # param tree (and every checkpoint) is identical with and
+                # without remat.
+                x = layer_cls(self.growth, self.bn_size, self.dtype,
+                              name=f"DenseLayer_{layer_idx}")(x, train)
+                layer_idx += 1
             if i != len(self.block_config) - 1:  # transition
                 x = nn.relu(norm()(x))
                 x = nn.Conv(x.shape[-1] // 2, (1, 1), use_bias=False,
@@ -64,5 +82,6 @@ class DenseNet(nn.Module):
         return x.astype(jnp.float32)
 
 
-def densenet121(num_classes: int, dtype=jnp.bfloat16) -> DenseNet:
-    return DenseNet(num_classes=num_classes, dtype=dtype)
+def densenet121(num_classes: int, dtype=jnp.bfloat16,
+                remat: bool = False) -> DenseNet:
+    return DenseNet(num_classes=num_classes, dtype=dtype, remat=remat)

@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Any, Tuple, Union
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from .common import adaptive_avg_pool
@@ -165,10 +166,30 @@ class AuxHead(nn.Module):
 class InceptionV3(nn.Module):
     num_classes: int = 10
     dtype: Any = jnp.bfloat16
+    # --remat blocks: recompute each Mixed block's interior in backward.
+    # The 299px stem stays un-checkpointed (it is a handful of convs; the
+    # activation bulk sits in the 35x35/17x17 Mixed blocks).
+    remat: bool = False
+
+    def _block(self, cls):
+        """Block class, nn.remat-wrapped under --remat blocks.  Call sites
+        pass explicit name= matching the historical auto-names so the
+        param tree is identical either way."""
+        if not self.remat:
+            return cls
+        # static_argnums=(2,): ``train`` (self is 0, x is 1).
+        return nn.remat(
+            cls, static_argnums=(2,),
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
 
     @nn.compact
     def __call__(self, x, train: bool = False
                  ) -> Union[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+        inc_a = self._block(InceptionA)
+        inc_b = self._block(InceptionB)
+        inc_c = self._block(InceptionC)
+        inc_d = self._block(InceptionD)
+        inc_e = self._block(InceptionE)
         x = x.astype(self.dtype)
         x = BasicConv(32, (3, 3), (2, 2), dtype=self.dtype)(x, train)
         x = BasicConv(32, (3, 3), dtype=self.dtype)(x, train)
@@ -178,17 +199,17 @@ class InceptionV3(nn.Module):
         x = BasicConv(80, (1, 1), dtype=self.dtype)(x, train)
         x = BasicConv(192, (3, 3), dtype=self.dtype)(x, train)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
-        x = InceptionA(32, self.dtype)(x, train)
-        x = InceptionA(64, self.dtype)(x, train)
-        x = InceptionA(64, self.dtype)(x, train)
-        x = InceptionB(self.dtype)(x, train)
-        for c7 in (128, 160, 160, 192):
-            x = InceptionC(c7, self.dtype)(x, train)
+        x = inc_a(32, self.dtype, name="InceptionA_0")(x, train)
+        x = inc_a(64, self.dtype, name="InceptionA_1")(x, train)
+        x = inc_a(64, self.dtype, name="InceptionA_2")(x, train)
+        x = inc_b(self.dtype, name="InceptionB_0")(x, train)
+        for i, c7 in enumerate((128, 160, 160, 192)):
+            x = inc_c(c7, self.dtype, name=f"InceptionC_{i}")(x, train)
         aux = AuxHead(self.num_classes, self.dtype)(x, train) if train \
             else None
-        x = InceptionD(self.dtype)(x, train)
-        x = InceptionE(self.dtype)(x, train)
-        x = InceptionE(self.dtype)(x, train)
+        x = inc_d(self.dtype, name="InceptionD_0")(x, train)
+        x = inc_e(self.dtype, name="InceptionE_0")(x, train)
+        x = inc_e(self.dtype, name="InceptionE_1")(x, train)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dropout(0.5, deterministic=not train)(x)
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)

@@ -22,18 +22,26 @@ from .vgg import VGG11BN
 from .vit import ViT
 
 MODEL_REGISTRY: Dict[str, Callable[..., nn.Module]] = {
-    "cnn": lambda n, d: SmallCNN(num_classes=n, dtype=d),
-    "mlp": lambda n, d: MLP(num_classes=n, dtype=d),
-    "resnet": lambda n, d: resnet18(n, d),           # ref utils.py:42-49
-    "alexnet": lambda n, d: AlexNet(num_classes=n, dtype=d),   # :51-58
-    "vgg": lambda n, d: VGG11BN(num_classes=n, dtype=d),       # :60-67
-    "squeezenet": lambda n, d: SqueezeNet(num_classes=n, dtype=d),  # :69-76
-    "densenet": lambda n, d: densenet121(n, d),      # :78-85
-    "inception": lambda n, d: InceptionV3(num_classes=n, dtype=d),  # :87-99
+    "cnn": lambda n, d, r: SmallCNN(num_classes=n, dtype=d),
+    "mlp": lambda n, d, r: MLP(num_classes=n, dtype=d),
+    "resnet": lambda n, d, r: resnet18(n, d),        # ref utils.py:42-49
+    "alexnet": lambda n, d, r: AlexNet(num_classes=n, dtype=d),  # :51-58
+    "vgg": lambda n, d, r: VGG11BN(num_classes=n, dtype=d),      # :60-67
+    "squeezenet": lambda n, d, r: SqueezeNet(num_classes=n, dtype=d),
+    "densenet": lambda n, d, r: densenet121(n, d, remat=r),  # :78-85
+    "inception": lambda n, d, r: InceptionV3(num_classes=n, dtype=d,
+                                             remat=r),       # :87-99
     # Framework addition beyond the reference zoo (which is CNN-only):
     # the attention model family, see models/vit.py + ops/attention.py.
-    "vit": lambda n, d: ViT(num_classes=n, dtype=d),
+    "vit": lambda n, d, r: ViT(num_classes=n, dtype=d, remat=r),
 }
+
+# Models that implement --remat blocks THEMSELVES via nn.remat at their
+# block boundaries (param-tree-preserving: the wrapped instances carry the
+# same explicit names the unwrapped modules get).  For everything else the
+# engine falls back to jax.checkpoint around the whole apply with a
+# save-matmul-outputs policy.
+REMAT_BLOCK_MODELS = frozenset({"vit", "densenet", "inception"})
 
 # name -> input resolution (ref getModelInputSize, utils.py:24-36: 224 for
 # all but inception=299; cnn/mlp/vit run at the dataset-native 28).
@@ -65,7 +73,8 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
               tensor_parallel: bool = False,
               pipeline_parallel: bool = False,
               pipeline_microbatches: int = 0,
-              moe_experts: int = 0, pallas_dw: bool = False) -> nn.Module:
+              moe_experts: int = 0, pallas_dw: bool = False,
+              precision=None, remat: str = "none") -> nn.Module:
     """``attention``: 'full' (default, XLA-fused softmax attention),
     'ring' (sequence-parallel over ``mesh``'s 'model' axis via
     lax.ppermute — ops/attention.py), 'flash' (the Pallas kernel,
@@ -83,7 +92,20 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
     if attention not in ("full", "ring", "flash", "ring_flash"):
         raise ValueError(f"attention must be 'full', 'ring', 'flash' or "
                          f"'ring_flash', got {attention!r}")
-    dtype = jnp.bfloat16 if half_precision else jnp.float32
+    if remat not in ("none", "blocks", "full"):
+        raise ValueError(f"remat must be none|blocks|full, got {remat!r}")
+    if precision is not None:
+        dtype = precision.compute_dtype
+    else:
+        dtype = jnp.bfloat16 if half_precision else jnp.float32
+    # Model-internal block remat only for --remat blocks; --remat full is
+    # handled by the engine (whole-apply jax.checkpoint), not the model.
+    remat_blocks = remat == "blocks"
+    if pipeline_parallel and remat != "none":
+        raise ValueError(
+            "--remat composes with the plain vit, not --pipeline-parallel "
+            "(the pipelined vit hand-rolls its stage loop and manages "
+            "per-stage memory itself)")
     if pallas_dw:
         # API-only knob (bench.py A/B path, no CLI flag): the measured
         # closure in BASELINE.md found XLA's native dW at its roofline,
@@ -185,7 +207,8 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
                                       "axes)")
             return ViT(num_classes=num_classes, dtype=dtype,
                        attention_fn=attn_fn,
-                       tp_constrain=make_tp_constrain(mesh))
+                       tp_constrain=make_tp_constrain(mesh),
+                       remat=remat_blocks)
         if moe_experts:
             # Expert parallelism when a model axis exists (>= 2 devices
             # on 'model'): the expert batches' leading E axis is pinned
@@ -210,10 +233,10 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
                 moe_constrain = make_tp_constrain(mesh)
             return ViT(num_classes=num_classes, dtype=dtype,
                        attention_fn=attn_fn, moe_experts=moe_experts,
-                       moe_constrain=moe_constrain)
+                       moe_constrain=moe_constrain, remat=remat_blocks)
         return ViT(num_classes=num_classes, dtype=dtype,
-                   attention_fn=attn_fn)
-    return MODEL_REGISTRY[name](num_classes, dtype)
+                   attention_fn=attn_fn, remat=remat_blocks)
+    return MODEL_REGISTRY[name](num_classes, dtype, remat_blocks)
 
 
 def get_model_input_size(name: str) -> int:

@@ -31,6 +31,8 @@ from typing import Any, Callable, Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
+import jax
+
 from ..ops.attention import full_attention
 from ..runtime import DATA_AXIS, MODEL_AXIS
 
@@ -113,10 +115,23 @@ class ViT(nn.Module):
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
     moe_constrain: Optional[ConstrainFn] = None
+    # --remat blocks: rematerialize each transformer block's interior in
+    # backward, keeping matmul outputs (the MXU work is not recomputed,
+    # only the cheap elementwise/normalization ops are).
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         attn_fn = self.attention_fn or full_attention
+        block_cls = TransformerBlock
+        if self.remat:
+            # static_argnums=(2,): ``train`` (self is 0, x is 1).  The
+            # explicit name= below keeps the param tree identical to the
+            # unwrapped module (nn.remat would otherwise auto-name
+            # instances CheckpointTransformerBlock_i).
+            block_cls = nn.remat(
+                TransformerBlock, static_argnums=(2,),
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
         x = x.astype(self.dtype)
         x = nn.Conv(self.dim, (self.patch, self.patch),
                     strides=(self.patch, self.patch), padding="VALID",
@@ -127,12 +142,12 @@ class ViT(nn.Module):
                          (1, gh * gw, self.dim), jnp.float32)
         x = x + pos.astype(self.dtype)
         for i in range(self.depth):
-            x = TransformerBlock(self.dim, self.heads, self.mlp_ratio,
-                                 self.dtype, attn_fn, self.tp_constrain,
-                                 moe_experts=self.moe_experts,
-                                 moe_capacity_factor=self.moe_capacity_factor,
-                                 moe_constrain=self.moe_constrain,
-                                 name=f"block{i}")(x, train=train)
+            x = block_cls(self.dim, self.heads, self.mlp_ratio,
+                          self.dtype, attn_fn, self.tp_constrain,
+                          moe_experts=self.moe_experts,
+                          moe_capacity_factor=self.moe_capacity_factor,
+                          moe_constrain=self.moe_constrain,
+                          name=f"block{i}")(x, train)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         x = jnp.mean(x, axis=1)  # mean-pool tokens
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)

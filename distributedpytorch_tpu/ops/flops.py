@@ -130,13 +130,49 @@ PEAK_BF16_FLOPS = [
     ("v2", 45e12),
 ]
 
+# Repo convention for the f32 denominator: half the bf16 peak.  Cloud TPU
+# datasheets publish only the bf16 (and int8) peak; XLA's default f32
+# matmul path feeds the MXU at half the bf16 issue rate, so f32 MFU
+# against the bf16 peak would be systematically understated by ~2x (and
+# bf16 MFU against an f32 peak inflated by the same factor).  The /2
+# convention is recorded as such (costs.record_mfu_denominator tags the
+# table as the source) pending a measured closure per device kind.
+F32_PEAK_FRACTION = 0.5
 
-def peak_flops(device_kind: str) -> Optional[float]:
-    """Peak dense bf16 FLOP/s for a ``Device.device_kind``, or None."""
+_DTYPE_LABELS = {
+    "bfloat16": "bf16", "float32": "f32", "float16": "f16",
+    "bf16": "bf16", "f32": "f32", "f16": "f16",
+}
+
+
+def dtype_label(dtype) -> str:
+    """Canonical short label ('bf16'/'f32'/'f16') for a compute dtype.
+
+    Accepts jnp dtypes, numpy dtypes, or the short label itself; unknown
+    dtypes come back verbatim (lowercased) so callers can still record
+    what was asked for."""
+    name = str(jnp.dtype(dtype).name) if not isinstance(dtype, str) \
+        else dtype
+    return _DTYPE_LABELS.get(name.lower(), name.lower())
+
+
+def peak_flops(device_kind: str, dtype="bf16") -> Optional[float]:
+    """Peak dense FLOP/s for a ``Device.device_kind`` at ``dtype``.
+
+    ``dtype`` may be a short label ('bf16'/'f32'/'f16') or an actual
+    dtype.  Returns None for unknown device kinds AND for dtypes the MXU
+    has no native path for (f16): callers must then omit MFU rather than
+    fabricate a denominator.  The one-argument form keeps its historical
+    meaning (bf16 peak)."""
+    label = dtype_label(dtype)
     kind = device_kind.lower()
     for key, peak in PEAK_BF16_FLOPS:
         if key in kind:
-            return peak
+            if label == "bf16":
+                return peak
+            if label == "f32":
+                return peak * F32_PEAK_FRACTION
+            return None
     return None
 
 

@@ -16,6 +16,14 @@ Denominator semantics match torch reductions exactly:
     reference's FocalLossN ends in .mean(), ref utils.py:155);
   * weighted_cross_entropy: denom = w_{y_n} (torch CrossEntropyLoss with
     weights divides by the sum of target weights).
+
+Precision contract (precision.PrecisionPolicy): every loss here upcasts
+the logits to ``accum_dtype`` (f32 for every shipped preset) BEFORE the
+log-softmax, so the numer/denom pairs the engine sums — per step and
+across a whole scanned epoch — are f32 regardless of the model's compute
+dtype.  A bf16 log-softmax has ~8 bits of mantissa; summing thousands of
+such terms is exactly the silent-accuracy-rot failure mode the
+``mixed-precision-accum`` graftlint rule exists to catch.
 """
 
 from __future__ import annotations
@@ -28,8 +36,12 @@ import jax.numpy as jnp
 LossFn = Callable[[jax.Array, jax.Array], Tuple[jax.Array, jax.Array]]
 
 
-def _log_softmax_gather(logits: jax.Array, labels: jax.Array) -> jax.Array:
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+def _log_softmax_gather(logits: jax.Array, labels: jax.Array,
+                        accum_dtype=jnp.float32) -> jax.Array:
+    # The upcast is the accumulation guarantee (module docstring): the
+    # softmax normalizer and the gathered log-prob are computed in
+    # accum_dtype even when the model emits bf16/f16 logits.
+    logp = jax.nn.log_softmax(logits.astype(accum_dtype), axis=-1)
     return jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
 
 

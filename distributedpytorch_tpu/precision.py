@@ -1,0 +1,184 @@
+"""Explicit mixed-precision policy (SURVEY §4 numerics).
+
+Replaces the single ``half_precision: bool`` that used to pick one dtype for
+everything.  A :class:`PrecisionPolicy` names four dtypes with distinct jobs:
+
+* ``param_dtype``   — storage dtype of the master parameters the optimizer
+  updates.  f32 masters + half-precision compute is the classic recipe
+  (Micikevicius et al., "Mixed Precision Training"): the tiny per-step
+  update would underflow if applied to a bf16/f16 copy.
+* ``compute_dtype`` — dtype of forward/backward matmuls.  Flax modules cast
+  params to this at apply time (``promote_dtype``), so the MXU runs
+  half-precision without ever storing a half master.
+* ``accum_dtype``   — dtype of every cross-step / cross-microbatch
+  accumulator: loss and metric sums, batch-norm running stats, and the
+  gradient buffer under ``--grad-accum``.  Always f32 in the shipped
+  presets; the ``mixed-precision-accum`` graftlint rule enforces that new
+  code keeps it that way.
+* ``output_dtype``  — dtype logits are cast to before the loss.  f32 so the
+  softmax/log-sum-exp runs at full precision regardless of compute dtype.
+
+TPU bf16 keeps the f32 exponent range, so the bf16 presets need no loss
+scaling.  The ``f16`` preset (non-TPU backends only) enables the dynamic
+loss-scaling state machine below, with overflow-skip and periodic growth.
+
+The reference trains pure f32 and has no precision knobs at all; this whole
+module is a framework divergence-by-addition, anchored to the ROADMAP "close
+the MFU gap" item.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """One named mixed-precision configuration.
+
+    ``loss_scale`` is the *initial* dynamic loss scale; 0.0 disables scaling
+    entirely (the bf16/f32 presets).  ``loss_scale_growth`` is the number of
+    consecutive finite steps after which the scale doubles.
+    """
+
+    name: str
+    param_dtype: Any
+    compute_dtype: Any
+    accum_dtype: Any
+    output_dtype: Any
+    loss_scale: float = 0.0
+    loss_scale_growth: int = 2000
+
+    @property
+    def scales_loss(self) -> bool:
+        return self.loss_scale > 0.0
+
+    def describe(self) -> dict:
+        """JSON-able summary, recorded in telemetry as ``precision_policy``."""
+        return {
+            "preset": self.name,
+            "param_dtype": jnp.dtype(self.param_dtype).name,
+            "compute_dtype": jnp.dtype(self.compute_dtype).name,
+            "accum_dtype": jnp.dtype(self.accum_dtype).name,
+            "output_dtype": jnp.dtype(self.output_dtype).name,
+            "loss_scale": float(self.loss_scale),
+        }
+
+
+# Preset table.  "bf16" formalizes what the repo always did implicitly:
+# flax keeps f32 params (param_dtype defaults to f32) and casts to the
+# module ``dtype`` at apply time, losses cast logits to f32 before the
+# log-sum-exp, and BN running stats live in f32.  "bf16_full" additionally
+# stores bf16 masters (halves param + optimizer-state memory; small-model
+# use only — updates below ~2^-8 of a weight's magnitude are lost).
+PRESETS = {
+    "f32": PrecisionPolicy(
+        name="f32",
+        param_dtype=jnp.float32, compute_dtype=jnp.float32,
+        accum_dtype=jnp.float32, output_dtype=jnp.float32,
+    ),
+    "bf16": PrecisionPolicy(
+        name="bf16",
+        param_dtype=jnp.float32, compute_dtype=jnp.bfloat16,
+        accum_dtype=jnp.float32, output_dtype=jnp.float32,
+    ),
+    "bf16_full": PrecisionPolicy(
+        name="bf16_full",
+        param_dtype=jnp.bfloat16, compute_dtype=jnp.bfloat16,
+        accum_dtype=jnp.float32, output_dtype=jnp.float32,
+    ),
+    # f16 has a 5-bit exponent: gradients underflow without scaling.  TPUs
+    # have no f16 MXU path, so this preset is rejected on TPU backends
+    # (cli validation) — it exists for GPU/CPU parity experiments.
+    "f16": PrecisionPolicy(
+        name="f16",
+        param_dtype=jnp.float32, compute_dtype=jnp.float16,
+        accum_dtype=jnp.float32, output_dtype=jnp.float32,
+        loss_scale=float(2 ** 15),
+    ),
+}
+
+PRESET_NAMES = tuple(PRESETS)
+
+
+def get_policy(name: str) -> PrecisionPolicy:
+    try:
+        return PRESETS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown precision preset {name!r}; choose from {PRESET_NAMES}"
+        ) from None
+
+
+def from_flags(precision: Optional[str], half_precision: bool) -> PrecisionPolicy:
+    """Resolve the CLI/Config pair into a policy.
+
+    ``--precision`` wins when given; otherwise the legacy ``half_precision``
+    bool maps to the preset that reproduces its historical behavior exactly
+    (True → "bf16", False → "f32").
+    """
+    if precision is not None:
+        return get_policy(precision)
+    return PRESETS["bf16" if half_precision else "f32"]
+
+
+def cast_floating(tree: Any, dtype: Any) -> Any:
+    """Cast floating-point leaves of a pytree to ``dtype``; leave ints alone."""
+    def _cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+    return jax.tree_util.tree_map(_cast, tree)
+
+
+class LossScaleState(flax.struct.PyTreeNode):
+    """Dynamic loss-scale carried inside TrainState (f16 preset only).
+
+    ``scale`` multiplies the loss before backward (gradients come out
+    scaled, the step divides them back).  ``good_steps`` counts consecutive
+    finite steps; at ``growth_interval`` the scale doubles.  A non-finite
+    gradient halves the scale and the parameter/optimizer update is skipped
+    (``jnp.where`` select, so the step stays one compiled program).
+    """
+
+    scale: jax.Array
+    good_steps: jax.Array
+
+    @classmethod
+    def create(cls, initial_scale: float) -> "LossScaleState":
+        return cls(scale=jnp.asarray(initial_scale, jnp.float32),
+                   good_steps=jnp.asarray(0, jnp.int32))
+
+    def adjust(self, grads_finite: jax.Array,
+               growth_interval: int = 2000) -> "LossScaleState":
+        grew = self.good_steps + 1 >= growth_interval
+        new_scale = jnp.where(
+            grads_finite,
+            jnp.where(grew, self.scale * 2.0, self.scale),
+            jnp.maximum(self.scale * 0.5, 1.0),
+        )
+        # Cap so a long run of clean steps cannot push the scale to inf.
+        new_scale = jnp.minimum(new_scale, jnp.asarray(2.0 ** 24, jnp.float32))
+        new_good = jnp.where(grads_finite & ~grew, self.good_steps + 1, 0)
+        return self.replace(scale=new_scale, good_steps=new_good)
+
+
+def all_finite(tree: Any) -> jax.Array:
+    """Scalar bool: every element of every leaf is finite."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(True)
+    checks = [jnp.all(jnp.isfinite(leaf)) for leaf in leaves]
+    return jnp.stack(checks).all()
+
+
+def tree_select(pred: jax.Array, on_true: Any, on_false: Any) -> Any:
+    """Elementwise pytree select — used for the overflow-skip update."""
+    return jax.tree_util.tree_map(
+        lambda t, f: jnp.where(pred, t, f), on_true, on_false
+    )

@@ -42,9 +42,11 @@ import optax
 from .. import runtime
 from ..data import augment
 from ..models.registry import (AUX_LOGIT_MODELS, DROPOUT_MODELS,
-                               trainable_mask)
+                               REMAT_BLOCK_MODELS, trainable_mask)
 from ..ops import per_example_correct
 from ..ops.losses import LossFn
+from ..precision import (LossScaleState, PrecisionPolicy, all_finite,
+                         cast_floating, from_flags, tree_select)
 
 
 class TrainState(flax.struct.PyTreeNode):
@@ -52,6 +54,9 @@ class TrainState(flax.struct.PyTreeNode):
     params: Any
     batch_stats: Any
     opt_state: Any
+    # Dynamic loss-scale state (precision.LossScaleState) — None for every
+    # preset except f16, so bf16/f32 checkpoints and pytrees are unchanged.
+    loss_scale: Any = None
 
 
 def make_optimizer(optimizer: str, learning_rate: float, momentum: float,
@@ -84,7 +89,9 @@ class Engine:
     def __init__(self, model, model_name: str, loss_fn: LossFn,
                  tx: optax.GradientTransformation, mean: float, std: float,
                  input_size: int, half_precision: bool = True,
-                 grad_accum: int = 1):
+                 grad_accum: int = 1,
+                 precision: Optional[PrecisionPolicy] = None,
+                 remat: str = "none"):
         self.model = model
         self.model_name = model_name
         self.loss_fn = loss_fn
@@ -92,18 +99,53 @@ class Engine:
         self.mean = float(mean)
         self.std = float(std)
         self.input_size = int(input_size)
-        self.compute_dtype = jnp.bfloat16 if half_precision else jnp.float32
+        # Explicit policy wins; the legacy bool maps onto the preset that
+        # reproduces its historical behavior (True -> "bf16", False ->
+        # "f32") so programmatic Engine(half_precision=...) callers keep
+        # working unchanged.
+        self.precision = precision or from_flags(None, half_precision)
+        self.compute_dtype = self.precision.compute_dtype
+        self.accum_dtype = self.precision.accum_dtype
         self.has_aux = model_name in AUX_LOGIT_MODELS
         self.uses_dropout = model_name in DROPOUT_MODELS
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         self.grad_accum = int(grad_accum)
+        if remat not in ("none", "blocks", "full"):
+            raise ValueError(f"remat must be none|blocks|full, got {remat!r}")
+        self.remat = remat
+        # Rematerialization of the grad-path forward.  Models with block
+        # submodules (REMAT_BLOCK_MODELS) carry nn.remat at their block
+        # boundaries (wired by the registry), which is both finer-grained
+        # and param-tree-preserving; for flat models "blocks" falls back to
+        # checkpointing the whole apply while SAVING matmul outputs (the
+        # recompute is then the cheap elementwise work only).  "full" saves
+        # nothing: maximum memory relief, backward recomputes the matmuls.
+        model_handles_remat = (remat == "blocks"
+                               and model_name in REMAT_BLOCK_MODELS)
+        if remat == "full":
+            self._grad_apply = jax.checkpoint(
+                self._apply, static_argnums=(3,))
+        elif remat == "blocks" and not model_handles_remat:
+            self._grad_apply = jax.checkpoint(
+                self._apply, static_argnums=(3,),
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        else:
+            self._grad_apply = self._apply
         # State donation is dropped where the persistent compilation
         # cache would corrupt it (CPU cache-hit executables lose their
         # aliasing metadata — see runtime.donation_safe).
         donate = (0,) if runtime.donation_safe() else ()
         self.train_step = jax.jit(self._train_step, donate_argnums=donate)
         self.eval_step = jax.jit(self._eval_step)
+        # Two-dispatch diagnostic variant of train_step: backward and
+        # optimizer as SEPARATE compiled programs.  scripts/precision_gate.py
+        # pins fused == unfused bit-identically in f32 — the proof that
+        # fusing the optimizer into the step program (and thereby deleting
+        # the optimizer_metrics_us dispatch) changed scheduling, not math.
+        self._fwd_bwd_jit = jax.jit(self._fwd_bwd)
+        self._opt_apply_jit = jax.jit(self._opt_apply,
+                                      donate_argnums=donate)
         # Device-resident whole-epoch programs (see train_epoch/eval_epoch):
         # one XLA dispatch per epoch instead of one per step.
         self.train_epoch = jax.jit(self._train_epoch, donate_argnums=donate)
@@ -122,7 +164,12 @@ class Engine:
         variables = jax.jit(
             functools.partial(self.model.init, train=True)
         )({"params": key, "dropout": jax.random.fold_in(key, 1)}, x)
-        params = variables["params"]
+        # Master params live in param_dtype.  Flax initializes f32 (its
+        # param_dtype default), so this cast is the identity for every
+        # preset except bf16_full, where it halves param + optimizer-state
+        # memory at the documented precision cost.
+        params = cast_floating(variables["params"],
+                               self.precision.param_dtype)
         try:  # abstract trace, no device work — gates _pregather
             from ..ops import flops as flops_mod
             self._flops_per_sample = flops_mod.train_flops_per_sample(
@@ -138,6 +185,8 @@ class Engine:
             params=params,
             batch_stats=variables.get("batch_stats", {}),
             opt_state=self.tx.init(params),
+            loss_scale=(LossScaleState.create(self.precision.loss_scale)
+                        if self.precision.scales_loss else None),
         )
 
     # -- shared pieces ----------------------------------------------------
@@ -161,11 +210,16 @@ class Engine:
             aux = sum(
                 (jnp.sum(leaf) for leaf in
                  jax.tree_util.tree_leaves(updated.get("losses", {}))),
-                jnp.zeros((), jnp.float32))
-            new_bs = updated.get("batch_stats", batch_stats)
+                jnp.zeros((), self.accum_dtype))
+            # BN running stats are cross-step accumulators: policy demands
+            # accum_dtype (flax already keeps them f32 — the EMA inside
+            # _compute_stats promotes half inputs — so this is a guard,
+            # not a conversion).
+            new_bs = cast_floating(updated.get("batch_stats", batch_stats),
+                                   self.accum_dtype)
             return out, new_bs, aux
         out = self.model.apply(variables, imgs, train=train, rngs=rngs)
-        return out, batch_stats, jnp.zeros((), jnp.float32)
+        return out, batch_stats, jnp.zeros((), self.accum_dtype)
 
     def _reduce_loss(self, logits, labels, vmask):
         numer, denom = self.loss_fn(logits, labels)
@@ -192,15 +246,28 @@ class Engine:
         imgs = augment.train_transform(
             aug_key, images_u8, self.mean, self.std, self.input_size,
             out_dtype=self.compute_dtype)
-        vmask = valid.astype(jnp.float32)
+        vmask = valid.astype(self.accum_dtype)
 
         if self.grad_accum > 1:
             return self._train_step_accum(state, imgs, labels, vmask,
                                           dropout_key)
 
+        grads, new_bs, loss, correct = self._grads_and_metrics(
+            state, imgs, labels, vmask, dropout_key)
+        return self._finish_step(state, grads, new_bs, loss, correct, vmask)
+
+    def _grads_and_metrics(self, state: TrainState, imgs, labels, vmask,
+                           dropout_key):
+        """Forward + backward of one full batch: (grads, new_bs, loss,
+        correct).  Under dynamic loss scaling the *differentiated* output
+        is loss * scale; gradients are unscaled before returning, and the
+        reported loss is the unscaled one."""
+        scale = (None if state.loss_scale is None
+                 else state.loss_scale.scale)
+
         def compute_loss(params):
-            out, new_bs, sown = self._apply(params, state.batch_stats,
-                                            imgs, True, dropout_key)
+            out, new_bs, sown = self._grad_apply(params, state.batch_stats,
+                                                 imgs, True, dropout_key)
             if self.has_aux:
                 logits, aux_logits = out
                 loss = (self._reduce_loss(logits, labels, vmask)
@@ -208,19 +275,48 @@ class Engine:
             else:
                 logits = out
                 loss = self._reduce_loss(logits, labels, vmask)
-            return loss + sown, (logits, new_bs)
+            loss = loss + sown
+            scaled = loss if scale is None else loss * scale
+            return scaled, (logits, new_bs, loss)
 
-        (loss, (logits, new_bs)), grads = jax.value_and_grad(
+        (_, (logits, new_bs, loss)), grads = jax.value_and_grad(
             compute_loss, has_aux=True)(state.params)
+        if scale is not None:
+            grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
         correct = jnp.sum(per_example_correct(logits, labels) * vmask)
-        return self._finish_step(state, grads, new_bs, loss, correct, vmask)
+        return grads, new_bs, loss, correct
 
     def _finish_step(self, state: TrainState, grads, new_bs, loss, correct,
                      vmask) -> Tuple[TrainState, Dict[str, jax.Array]]:
-        """Shared optimizer-update + metrics tail of both step variants."""
+        """Shared optimizer-update + metrics tail of both step variants.
+
+        Lives INSIDE the jitted train-step program (fused step): cast-grads
+        -> optax update -> apply-updates -> metrics compile into the same
+        executable as forward/backward, so there is no separate optimizer
+        dispatch (the ``optimizer_metrics_us`` stage of PROFILE_BREAKDOWN
+        collapses to zero extra dispatches).
+        """
+        # cast-grads: the optimizer and master-param update run in
+        # param_dtype regardless of what dtype the backward produced.
+        grads = jax.tree_util.tree_map(
+            lambda g, p: g.astype(p.dtype), grads, state.params)
         updates, new_opt_state = self.tx.update(grads, state.opt_state,
                                                 state.params)
         new_params = optax.apply_updates(state.params, updates)
+        new_ls = state.loss_scale
+        if state.loss_scale is not None:
+            # Overflow-skip: a non-finite gradient keeps params/opt
+            # state/BN stats and halves the scale — all as jnp.where
+            # selects, so the step remains ONE compiled program.  step
+            # still advances (the _epoch_keys hoisting contract requires
+            # +1 per iteration unconditionally).
+            finite = all_finite(grads)
+            new_params = tree_select(finite, new_params, state.params)
+            new_opt_state = tree_select(finite, new_opt_state,
+                                        state.opt_state)
+            new_bs = tree_select(finite, new_bs, state.batch_stats)
+            new_ls = state.loss_scale.adjust(
+                finite, self.precision.loss_scale_growth)
         metrics = {
             "loss": loss,
             "correct": correct,
@@ -228,7 +324,41 @@ class Engine:
         }
         return state.replace(step=state.step + 1, params=new_params,
                              batch_stats=new_bs,
-                             opt_state=new_opt_state), metrics
+                             opt_state=new_opt_state,
+                             loss_scale=new_ls), metrics
+
+    # -- unfused diagnostic path ------------------------------------------
+
+    def _fwd_bwd(self, state: TrainState, images_u8, labels, valid,
+                 key: jax.Array):
+        if self.grad_accum > 1:
+            raise ValueError("the unfused diagnostic path supports "
+                             "grad_accum=1 only")
+        step_key = jax.random.fold_in(key, state.step)
+        aug_key, dropout_key = jax.random.split(step_key)
+        imgs = augment.train_transform(
+            aug_key, images_u8, self.mean, self.std, self.input_size,
+            out_dtype=self.compute_dtype)
+        vmask = valid.astype(self.accum_dtype)
+        grads, new_bs, loss, correct = self._grads_and_metrics(
+            state, imgs, labels, vmask, dropout_key)
+        return grads, new_bs, loss, correct, vmask
+
+    def _opt_apply(self, state: TrainState, grads, new_bs, loss, correct,
+                   vmask):
+        return self._finish_step(state, grads, new_bs, loss, correct, vmask)
+
+    def train_step_unfused(self, state: TrainState, images_u8, labels,
+                           valid, key: jax.Array
+                           ) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        """The pre-fusion execution shape: backward and optimizer as TWO
+        separately compiled dispatches.  Kept only so the precision gate
+        can pin fused == unfused bit-identically in f32; production paths
+        all use the fused ``train_step``/epoch programs."""
+        grads, new_bs, loss, correct, vmask = self._fwd_bwd_jit(
+            state, images_u8, labels, valid, key)
+        return self._opt_apply_jit(state, grads, new_bs, loss, correct,
+                                   vmask)
 
     def _train_step_accum(self, state: TrainState, imgs, labels, vmask,
                           dropout_key
@@ -276,9 +406,12 @@ class Engine:
 
         imgs_m, labels_m, vmask_m = shard(imgs), shard(labels), shard(vmask)
 
+        scale = (None if state.loss_scale is None
+                 else state.loss_scale.scale)
+
         def numer_fn(params, batch_stats, im, lb, vm, dkey):
-            out, new_bs, sown = self._apply(params, batch_stats, im, True,
-                                            dkey)
+            out, new_bs, sown = self._grad_apply(params, batch_stats, im,
+                                                 True, dkey)
             if self.has_aux:
                 logits, aux_logits = out
                 n_main, d = self.loss_fn(logits, lb)
@@ -295,6 +428,8 @@ class Engine:
             # from the K=1 step, which computes aux on the full batch).
             numer = numer + sown * jnp.sum(d * vm)
             correct = jnp.sum(per_example_correct(logits, lb) * vm)
+            if scale is not None:
+                numer = numer * scale
             return numer, (new_bs, jnp.sum(d * vm), correct)
 
         grad_fn = jax.value_and_grad(numer_fn, has_aux=True)
@@ -310,15 +445,22 @@ class Engine:
             return (grads_acc, numer + n, denom + d, correct + c,
                     new_bs), None
 
+        # Gradient accumulation happens in accum_dtype (f32 in every
+        # shipped preset): bf16/f16 per-microbatch grads are promoted on
+        # add, so the K-way sum never loses mantissa to the compute dtype.
         zeros = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            lambda p: jnp.zeros(p.shape, self.accum_dtype), state.params)
+        acc0 = jnp.zeros((), self.accum_dtype)
         (grads_n, numer, denom, correct, new_bs), _ = jax.lax.scan(
-            micro, (zeros, 0.0, 0.0, 0.0, state.batch_stats),
+            micro, (zeros, acc0, acc0, acc0, state.batch_stats),
             (jnp.arange(k), imgs_m, labels_m, vmask_m))
 
         denom_safe = jnp.maximum(denom, 1e-9)
-        grads = jax.tree_util.tree_map(lambda g: g / denom_safe, grads_n)
-        loss = numer / denom_safe
+        # Under loss scaling the accumulated numerator (and hence grads_n)
+        # carries the scale; fold the unscale into the single final divide.
+        eff = denom_safe if scale is None else denom_safe * scale
+        grads = jax.tree_util.tree_map(lambda g: g / eff, grads_n)
+        loss = numer / eff
         return self._finish_step(state, grads, new_bs, loss, correct, vmask)
 
     # -- whole-epoch device-resident programs ----------------------------
@@ -413,7 +555,7 @@ class Engine:
 
     def _eval_epoch(self, state: TrainState, images_all, labels_all,
                     idx, valid) -> Dict[str, jax.Array]:
-        zeros = {k: jnp.zeros((), jnp.float32)
+        zeros = {k: jnp.zeros((), self.accum_dtype)
                  for k in ("loss_numer", "loss_denom", "correct", "valid")}
         pre = self._pregather(images_all, labels_all, idx)
         if pre is not None:
@@ -469,7 +611,7 @@ class Engine:
         imgs = augment.eval_transform(images_u8, self.mean, self.std,
                                       self.input_size,
                                       out_dtype=self.compute_dtype)
-        vmask = valid.astype(jnp.float32)
+        vmask = valid.astype(self.accum_dtype)
         out, _, _ = self._apply(state.params, state.batch_stats, imgs,
                              False, None)
         logits = out[0] if isinstance(out, tuple) else out

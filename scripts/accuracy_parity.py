@@ -213,7 +213,7 @@ def run_reference(ds, epochs: int, batch: int, seed: int,
 
 def run_ours(dataset: str, data_path: str, epochs: int, batch: int,
              seed: int, rsl: str, train_limit: int,
-             optimizer: str = "adam") -> dict:
+             optimizer: str = "adam", data_mode: str = "auto") -> dict:
     from distributedpytorch_tpu import checkpoint as ckpt
     from distributedpytorch_tpu.cli import run_test, run_train
     from distributedpytorch_tpu.config import Config
@@ -229,6 +229,7 @@ def run_ours(dataset: str, data_path: str, epochs: int, batch: int,
                  # the framework spells it like the reference (config.py
                  # OPTIMIZER_CHOICES: 'adam' | 'SGD')
                  optimizer="SGD" if optimizer == "sgd" else optimizer,
+                 data_mode=data_mode,
                  synthetic_fallback=dataset.startswith("synthetic"))
     result = run_train(cfg)
     best = ckpt.best_model_path(rsl, dataset, "cnn")
@@ -273,6 +274,14 @@ def main() -> int:
                    help="reference-side weight init: 'torch' (the real "
                         "reference, torchvision defaults) or 'lecun' "
                         "(flax-style control — diagnostic only)")
+    p.add_argument("--data-mode", choices=("auto", "stream", "resident"),
+                   default="auto",
+                   help="ours-side data mode.  'stream' matters on slow "
+                        "single-core hosts: the resident whole-epoch scan "
+                        "compiles to pathological XLA-CPU code there "
+                        "(~26 s/step vs ~0.45 s/step streaming, measured) "
+                        "while the two modes are numerics-identical "
+                        "(tests/test_resident.py)")
     p.add_argument("--skip-ours", action="store_true")
     p.add_argument("--skip-reference", action="store_true")
     args = p.parse_args()
@@ -294,7 +303,7 @@ def main() -> int:
     ours = (None if args.skip_ours else
             run_ours(dataset, args.data_path, args.epochs, args.batch,
                      args.seed, args.rsl, args.train_limit,
-                     args.optimizer))
+                     args.optimizer, args.data_mode))
     ref = (None if args.skip_reference else
            run_reference(ds, args.epochs, args.batch, args.seed,
                          args.train_limit, args.optimizer,

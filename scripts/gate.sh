@@ -14,16 +14,21 @@
 #   3. transfer-guard smoke: one CPU streaming epoch with device->host
 #      syncs disallowed outside the sanctioned per-epoch points — the
 #      runtime sanitizer for the paper's per-batch .item() bug class
-#   4. chaos gate: a short CPU run under a canned fault plan (transient
+#   4. precision gate: the PrecisionPolicy contract — per-preset loss
+#      parity vs f32, f32 accumulators proven from telemetry, fused
+#      train step bit-identical to the two-dispatch path in f32 — see
+#      scripts/precision_gate.py and README "Precision policy, fused
+#      step & remat"
+#   5. chaos gate: a short CPU run under a canned fault plan (transient
 #      read errors, mid-run SIGTERM, torn head checkpoint, two-rank
 #      fatal fault) proving every failure path recovers — see
 #      scripts/chaos_gate.py and README "Fault tolerance & chaos testing"
-#   5. anomaly gate: deterministic stall -> anomaly event + exactly one
+#   6. anomaly gate: deterministic stall -> anomaly event + exactly one
 #      programmatic profiler capture + flight-record dump; clean-run
 #      false-positive check; recorder overhead budget; 2-rank timeline
 #      merge — see scripts/anomaly_gate.py and README "Flight recorder,
 #      anomaly profiling & timeline"
-#   6. the driver's own gate: __graft_entry__.dryrun_multichip(8)
+#   7. the driver's own gate: __graft_entry__.dryrun_multichip(8)
 #      (clean env, exactly as the driver runs it)
 #
 # Tier map:
@@ -61,6 +66,9 @@ env -u XLA_FLAGS -u JAX_PLATFORMS python scripts/overlap_gate.py
 
 echo "== gate: transfer-guard smoke (runtime sanitizer) =="
 env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/graftlint.py --smoke
+
+echo "== gate: precision (preset parity / f32 accum / fused step) =="
+env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/precision_gate.py
 
 echo "== gate: chaos (fault injection / retry / lineage recovery) =="
 env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py

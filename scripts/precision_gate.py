@@ -1,0 +1,209 @@
+#!/usr/bin/env python3
+"""Precision gate leg (scripts/gate.sh): the PrecisionPolicy contract,
+end to end on CPU.
+
+Four stages, all bounded:
+
+  A. preset parity — a short synthetic run per preset (f32 reference,
+     then bf16 / bf16_full / f16): every run must finish with finite
+     losses, and each preset's loss curve must agree with the f32
+     reference within a preset-specific tolerance (bf16 compute noise
+     is real; divergence is a policy-plumbing bug).
+  B. accumulator provenance — each run's telemetry must carry the
+     ``precision_policy`` event, and its ``accum_dtype`` must be
+     float32 for EVERY preset: loss/metric accumulation never happens
+     in a half dtype (the mixed-precision-accum lint rule's runtime
+     counterpart).  The f16 run must also record its loss scale.
+  C. fused == unfused — in f32 the fused train step (one jitted
+     program: fwd+bwd+optimizer+metrics) must be BIT-identical to the
+     diagnostic two-dispatch path over several steps; any drift means
+     the fusion changed the math, not just the schedule.
+  D. one-program evidence — the fused step AOT-compiles to a single
+     executable whose one invocation advances the optimizer (step+1,
+     params changed) AND returns the metrics; its cost estimate is
+     recorded in the shared costs registry like every other program.
+
+Run as ``env -u XLA_FLAGS JAX_PLATFORMS=cpu python
+scripts/precision_gate.py``.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+EPOCHS = 3
+# |train_loss - f32 train_loss| allowed per epoch.  bf16 presets round
+# activations (and for bf16_full, params) to 8 mantissa bits — on this
+# tiny synthetic problem the curves stay close but not equal.  f16 keeps
+# f32 master params and scales the loss, so it tracks tighter.
+LOSS_TOL = {"bf16": 0.25, "bf16_full": 0.35, "f16": 0.15}
+
+
+def _events(rsl: str) -> list:
+    path = os.path.join(rsl, "telemetry", "rank0.jsonl")
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _policy_events(rsl: str) -> list:
+    return [e for e in _events(rsl)
+            if e.get("kind") == "event"
+            and e.get("name") == "precision_policy"]
+
+
+def _cfg(rsl: str, preset: str):
+    from distributedpytorch_tpu.config import Config
+
+    return Config(action="train", data_path="/nodata", rsl_path=rsl,
+                  dataset="synthetic", model_name="mlp", batch_size=8,
+                  nb_epochs=EPOCHS, debug=True, precision=preset,
+                  telemetry=True)
+
+
+def _curve(result) -> list:
+    return [float(h["train_loss"]) for h in result["history"]]
+
+
+def main() -> int:
+    from __graft_entry__ import _force_cpu_devices
+
+    _force_cpu_devices(1)
+
+    import jax
+    import numpy as np
+
+    from distributedpytorch_tpu import costs
+    from distributedpytorch_tpu.cli import run_train
+    from distributedpytorch_tpu.models.registry import get_model
+    from distributedpytorch_tpu.ops.losses import get_loss_fn
+    from distributedpytorch_tpu.precision import PRESET_NAMES, get_policy
+    from distributedpytorch_tpu.train.engine import Engine, make_optimizer
+
+    problems = []
+    work = tempfile.mkdtemp(prefix="precision_gate_")
+
+    # -- stage A+B: preset parity + accumulator provenance ------------
+    curves = {}
+    for preset in PRESET_NAMES:
+        rsl = os.path.join(work, preset)
+        result = run_train(_cfg(rsl, preset))
+        curves[preset] = _curve(result)
+        if len(curves[preset]) != EPOCHS \
+                or not all(np.isfinite(curves[preset])):
+            problems.append(f"{preset}: run did not finish {EPOCHS} "
+                            f"finite epochs: {curves[preset]}")
+            continue
+        pol = _policy_events(rsl)
+        if not pol:
+            problems.append(f"{preset}: no precision_policy telemetry "
+                            f"event")
+            continue
+        ev = pol[-1].get("attrs", {})
+        if ev.get("preset") != preset:
+            problems.append(f"{preset}: telemetry preset mismatch: "
+                            f"{ev.get('preset')!r}")
+        if ev.get("accum_dtype") != "float32":
+            problems.append(
+                f"{preset}: accum_dtype is {ev.get('accum_dtype')!r}, "
+                f"not float32 — loss/metric accumulators must be f32 "
+                f"under every preset")
+        want_param = {"f32": "float32", "bf16": "float32",
+                      "bf16_full": "bfloat16", "f16": "float32"}[preset]
+        if ev.get("param_dtype") != want_param:
+            problems.append(f"{preset}: param_dtype "
+                            f"{ev.get('param_dtype')!r} != {want_param}")
+        if preset == "f16" and not ev.get("loss_scale"):
+            problems.append("f16: telemetry records no loss scale")
+        print(f"precision gate A: {preset} curve "
+              f"{[round(c, 4) for c in curves[preset]]}")
+
+    ref = curves.get("f32")
+    if ref:
+        for preset, tol in LOSS_TOL.items():
+            got = curves.get(preset)
+            if not got or len(got) != len(ref):
+                continue  # already reported above
+            worst = max(abs(a - b) for a, b in zip(got, ref))
+            if worst > tol:
+                problems.append(
+                    f"{preset}: loss curve diverges from f32 by "
+                    f"{worst:.4f} (tol {tol}) — policy plumbing bug, "
+                    f"not rounding noise")
+            else:
+                print(f"precision gate A: {preset} vs f32 max epoch "
+                      f"delta {worst:.4f} (tol {tol})")
+
+    # -- stage C: fused == unfused, bit-identical in f32 --------------
+    pol = get_policy("f32")
+    model = get_model("mlp", 10, precision=pol)
+    tx = make_optimizer("adam", 1e-3, 0.9, 0.1, 10, False)
+
+    def build():
+        eng = Engine(model, "mlp", get_loss_fn("cross_entropy"), tx,
+                     0.13, 0.3, 28, precision=pol)
+        return eng, eng.init_state(jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(7)
+    batches = [(rng.integers(0, 255, (8, 28, 28, 3)).astype(np.uint8),
+                rng.integers(0, 10, (8,)).astype(np.int32),
+                np.ones((8,), bool)) for _ in range(4)]
+    key = jax.random.PRNGKey(11)
+
+    eng_f, st_f = build()
+    for imgs, labels, valid in batches:
+        st_f, _ = eng_f.train_step(st_f, imgs, labels, valid, key)
+    eng_u, st_u = build()
+    for imgs, labels, valid in batches:
+        st_u, _ = eng_u.train_step_unfused(st_u, imgs, labels, valid,
+                                           key)
+    leaves_f = jax.tree_util.tree_leaves(jax.device_get(st_f.params))
+    leaves_u = jax.tree_util.tree_leaves(jax.device_get(st_u.params))
+    bitwise = all(
+        np.array_equal(np.asarray(a).view(np.uint8),
+                       np.asarray(b).view(np.uint8))
+        for a, b in zip(leaves_f, leaves_u))
+    if not bitwise:
+        worst = max(float(np.max(np.abs(np.asarray(a, np.float64)
+                                        - np.asarray(b, np.float64))))
+                    for a, b in zip(leaves_f, leaves_u))
+        problems.append(f"fused vs unfused params differ in f32 "
+                        f"(max |delta| {worst:.3e}) — fusion changed "
+                        f"the math")
+    else:
+        print(f"precision gate C: fused == unfused bit-identical over "
+              f"{len(batches)} f32 steps")
+
+    # -- stage D: the fused step is ONE compiled program --------------
+    costs.reset()
+    eng_d, st_d = build()
+    imgs, labels, valid = batches[0]
+    compiled = eng_d.train_step.lower(st_d, imgs, labels, valid,
+                                      key).compile()
+    costs.record("train_step_fused", compiled)
+    st_after, metrics = compiled(st_d, imgs, labels, valid, key)
+    if int(jax.device_get(st_after.step)) != 1:
+        problems.append("fused program did not advance the optimizer "
+                        "step in its single invocation")
+    if not set(metrics) >= {"loss", "correct", "valid"}:
+        problems.append(f"fused program returned incomplete metrics: "
+                        f"{sorted(metrics)}")
+    if "train_step_fused" not in costs.registry():
+        problems.append("fused step not recorded in the costs registry")
+    else:
+        print("precision gate D: one executable ran fwd+bwd+optimizer"
+              "+metrics and is cost-registered")
+
+    if problems:
+        print("precision gate RED:")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print("precision gate GREEN")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

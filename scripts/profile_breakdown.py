@@ -95,7 +95,10 @@ def main() -> int:
     out_dim = engine.input_size
     cdt = engine.compute_dtype
     device_kind = jax.devices()[0].device_kind
-    peak = peak_flops(device_kind)
+    # Honest MFU: the peak denominator matches the run's compute dtype
+    # (ops/flops.py per-dtype table); the report records which one.
+    peak_dtype = flops_mod.dtype_label(cdt)
+    peak = peak_flops(device_kind, peak_dtype)
     gb = loader.global_batch
 
     # --- the ladder of partial programs (each: scan, scalar carry) -------
@@ -189,6 +192,8 @@ def main() -> int:
         engine.model, host_params, host_bs, batch=gb, input_size=out_dim)
     costs.record_analytic("train_flops_per_sample", flops_per_sample=fps,
                           note="profile_breakdown analytic (ops.flops)")
+    if peak is not None:
+        costs.record_mfu_denominator(peak, peak_dtype, device_kind)
     n_params = sum(int(np.prod(np.shape(l)))
                    for l in jax.tree_util.tree_leaves(host_params))
 
@@ -217,6 +222,8 @@ def main() -> int:
         "train_flops_per_step": fps * gb,
         "ideal_matmul_us_at_peak": round(ideal_us, 2) if ideal_us else None,
         "mfu": (fps * gb / (results["full_step"] * peak)) if peak else None,
+        "mfu_peak_dtype": peak_dtype,
+        "mfu_peak_flops_per_chip": peak,
         "n_params": n_params,
         # both methodologies, provenance-stamped (costs.py)
         "cost_registry": costs.registry(),
@@ -227,7 +234,7 @@ def main() -> int:
         log(f"  {k:24s} {v:8.1f}")
     if ideal_us:
         log(f"  {'ideal_at_peak':24s} {ideal_us:8.1f}   "
-            f"(analytic FLOPs / {peak / 1e12:.0f} TF/s)")
+            f"(analytic FLOPs / {peak / 1e12:.0f} TF/s {peak_dtype})")
         log(f"  MFU {out['mfu'] * 100:.1f}%")
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -18,6 +18,13 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SEEDS = [1234, 7, 99, 41, 2024]
 SGD_SEEDS = [1234]
+# Environment knobs for slow hosts (the round-6 container runs XLA-CPU at
+# ~1/15th the round-5 machine's rate on one core; the resident epoch-scan
+# is pathological there — see accuracy_parity.py --data-mode):
+#   DPT_PARITY_TIMEOUT    per-run subprocess timeout, seconds (default 1500)
+#   DPT_PARITY_DATA_MODE  ours-side data mode: auto|stream|resident
+RUN_TIMEOUT = int(os.environ.get("DPT_PARITY_TIMEOUT", "1500"))
+DATA_MODE = os.environ.get("DPT_PARITY_DATA_MODE", "auto")
 
 
 def log(msg: str) -> None:
@@ -56,7 +63,8 @@ def one(seed: int, optimizer: str, ref_init: str = "torch",
     # config (doc-only commits deliberately keep entries valid).
     tag = f"{_tree_rev()}_{optimizer}_{seed}" \
         + ("" if ref_init == "torch" else f"_{ref_init}") \
-        + ("_refonly" if skip_ours else "")
+        + ("_refonly" if skip_ours else "") \
+        + ("" if DATA_MODE == "auto" else f"_{DATA_MODE}")
     cache = f"/tmp/parity_cache_{tag}.json"
     if os.path.exists(cache):
         log(f"=== parity seed {seed} optimizer {optimizer} (cached) ===")
@@ -66,15 +74,17 @@ def one(seed: int, optimizer: str, ref_init: str = "torch",
                                         "accuracy_parity.py"),
            "--dataset", "synthetic_hard", "--seed", str(seed),
            "--optimizer", optimizer, "--ref-init", ref_init,
-           "--rsl", f"/tmp/parity_rsl_{tag}"]
+           "--rsl", f"/tmp/parity_rsl_{tag}",
+           "--data-mode", DATA_MODE]
     if skip_ours:
         cmd.append("--skip-ours")
     log(f"=== parity seed {seed} optimizer {optimizer} "
-        f"init {ref_init} ===")
+        f"init {ref_init} (data-mode {DATA_MODE}, "
+        f"timeout {RUN_TIMEOUT}s) ===")
     # Normal runs take ~7-8 min; a hung TPU tunnel (backend init that
     # neither errors nor returns) would otherwise pin the whole suite.
     res = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
-                         timeout=1500)
+                         timeout=RUN_TIMEOUT)
     if res.returncode != 0:
         log(res.stderr[-4000:])
         raise RuntimeError(f"parity run failed (seed {seed})")
@@ -123,11 +133,17 @@ def main() -> int:
     ref = [r["reference"]["test_acc"] for r in runs]
     deltas = [round((o - r) * 100, 2) for o, r in zip(ours, ref)]
     out = {
-        "round": 5,
+        "round": 6,
         "corpus": "synthetic_hard (data/io.py SYNTH_HARD: class_sep 0.45,"
                   " noise 70)",
         "protocol": "2 epochs, batch 64, best-valid-loss model both "
                     "sides, identical corpus/split per seed",
+        "precision_policy": "bf16 (the default: f32 master params, "
+                            "bfloat16 compute, f32 accumulation — the "
+                            "same dtypes every earlier round ran "
+                            "implicitly, now named and telemetry-"
+                            "recorded)",
+        "data_mode": DATA_MODE,
         "n_seeds": len(runs),
         "seeds": [r["seed"] for r in runs],
         "runs_failed": failed,

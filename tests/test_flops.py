@@ -55,3 +55,36 @@ def test_train_flops_is_3x_forward():
     per_sample = flops.train_flops_per_sample(model, p, bs, batch=8,
                                               input_size=28)
     np.testing.assert_allclose(per_sample, 3 * fwd / 8)
+
+
+def test_peak_flops_per_dtype_pinned():
+    """Both MFU denominators pinned per device generation: bf16 is the
+    published datasheet rate, f32 is half of it (F32_PEAK_FRACTION — the
+    repo's documented convention, ops/flops.py)."""
+    assert flops.F32_PEAK_FRACTION == 0.5
+    for kind, bf16 in (("TPU v5e", 197e12), ("TPU v4", 275e12),
+                       ("TPU v3", 123e12), ("TPU v5p", 459e12),
+                       ("TPU v6e", 918e12)):
+        assert flops.peak_flops(kind) == bf16, kind            # historical
+        assert flops.peak_flops(kind, "bf16") == bf16, kind
+        assert flops.peak_flops(kind, "f32") == bf16 * 0.5, kind
+        assert flops.peak_flops(kind, jnp.float32) == bf16 * 0.5, kind
+        # no native MXU f16 path: denominator must be absent, not faked
+        assert flops.peak_flops(kind, "f16") is None, kind
+
+
+def test_peak_flops_unknown_kind_and_dtype():
+    # unknown device kinds (incl. CPU hosts) report None at every dtype
+    for dt in ("bf16", "f32", "f16"):
+        assert flops.peak_flops("cpu", dt) is None
+        assert flops.peak_flops("Radeon", dt) is None
+
+
+def test_dtype_label_normalization():
+    assert flops.dtype_label(jnp.bfloat16) == "bf16"
+    assert flops.dtype_label(jnp.float32) == "f32"
+    assert flops.dtype_label(jnp.float16) == "f16"
+    assert flops.dtype_label(np.dtype("float32")) == "f32"
+    assert flops.dtype_label("bf16") == "bf16"
+    # unknown dtypes come back verbatim (lowercased), never raise
+    assert flops.dtype_label("int8") == "int8"

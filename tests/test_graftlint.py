@@ -603,6 +603,96 @@ def test_profiler_trace_leak_class_close_negative(tmp_path):
                  rule="profiler-trace-leak") == []
 
 
+# -- rule 11: mixed-precision-accum ------------------------------------
+
+def test_mixed_precision_accum_reduction_positive(tmp_path):
+    src = """
+        import jax.numpy as jnp
+
+        def epoch_loss(losses):
+            return jnp.sum(losses, dtype=jnp.bfloat16)
+
+        def epoch_mean(losses):
+            return jnp.mean(losses, dtype="float16")
+    """
+    found = _lint(tmp_path, {"mod.py": src},
+                  rule="mixed-precision-accum")
+    assert len(found) == 2
+    assert all("half dtype" in f.message for f in found)
+
+
+def test_mixed_precision_accum_buffer_positive(tmp_path):
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        def running_sum(xs):
+            acc = jnp.zeros((), jnp.bfloat16)
+            for x in xs:
+                acc = acc + x
+            return acc
+
+        def scanned_sum(xs):
+            acc = jnp.zeros((4,), dtype=jnp.float16)
+            def body(c, x):
+                return c + x, None
+            out, _ = jax.lax.scan(body, acc, xs)
+            return out
+    """
+    found = _lint(tmp_path, {"mod.py": src},
+                  rule="mixed-precision-accum")
+    hows = " | ".join(f.message for f in found)
+    assert len(found) == 2, hows
+    assert "rebound to an expression of itself" in hows
+    assert "lax.scan" in hows
+
+
+def test_mixed_precision_accum_negative(tmp_path):
+    # f32 accumulation with a final downcast is the sanctioned pattern;
+    # half-dtype buffers that are never accumulated into are fine too.
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        def running_sum(xs):
+            acc = jnp.zeros((), jnp.float32)
+            for x in xs:
+                acc = acc + x
+            return acc.astype(jnp.bfloat16)
+
+        def activations(x):
+            pad = jnp.zeros((4,), jnp.bfloat16)   # not an accumulator
+            return jnp.concatenate([x, pad])
+
+        def f32_reduce(losses):
+            return jnp.sum(losses, dtype=jnp.float32)
+    """
+    assert _lint(tmp_path, {"mod.py": src},
+                 rule="mixed-precision-accum") == []
+
+
+def test_mixed_precision_accum_suppression_needs_rationale(tmp_path):
+    src = """
+        import jax.numpy as jnp
+
+        def checksum(xs):
+            # graftlint: disable=mixed-precision-accum -- parity checksum
+            # reproduces the device's own bf16 summation order on purpose
+            return jnp.sum(xs, dtype=jnp.bfloat16)
+    """
+    assert _lint(tmp_path, {"mod.py": src},
+                 rule="mixed-precision-accum") == []
+    bad = """
+        import jax.numpy as jnp
+
+        def checksum(xs):
+            return jnp.sum(xs, dtype=jnp.bfloat16)  # graftlint: disable=mixed-precision-accum
+    """
+    findings = _lint(tmp_path, {"mod2.py": bad})
+    assert sorted({f.rule for f in findings}) == [
+        "bad-suppression", "mixed-precision-accum"]
+
+
 # -- suppressions ------------------------------------------------------
 
 def test_suppression_with_rationale_silences(tmp_path):

@@ -1,0 +1,285 @@
+"""PrecisionPolicy subsystem (precision.py + engine threading): preset
+semantics, fused-step equivalence, remat numerics, loss scaling, and the
+config/CLI/checkpoint round trip."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu import checkpoint as ckpt
+from distributedpytorch_tpu.config import Config, config_from_argv
+from distributedpytorch_tpu.models.registry import (REMAT_BLOCK_MODELS,
+                                                    get_model)
+from distributedpytorch_tpu.ops.losses import get_loss_fn
+from distributedpytorch_tpu.precision import (PRESETS, LossScaleState,
+                                              all_finite, from_flags,
+                                              get_policy, tree_select)
+from distributedpytorch_tpu.train.engine import Engine, make_optimizer
+
+
+def _engine(model_name="mlp", preset="f32", remat="none", grad_accum=1,
+            optimizer="adam"):
+    # equivalence tests pass optimizer="SGD": its update is linear in the
+    # gradient, so grad-level equality shows through (Adam's first-step
+    # g/(sqrt(v)+eps) amplifies fp noise on near-zero grads — the same
+    # rationale as tests/test_grad_accum.py)
+    pol = get_policy(preset)
+    model = get_model(model_name, 10, precision=pol, remat=remat)
+    tx = make_optimizer(optimizer, 1e-3, 0.9, 0.1, 10, False)
+    eng = Engine(model, model_name, get_loss_fn("cross_entropy"), tx,
+                 0.13, 0.3, 28, precision=pol, remat=remat,
+                 grad_accum=grad_accum)
+    return eng, eng.init_state(jax.random.PRNGKey(0))
+
+
+def _batch(n=8, size=28, seed=3):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, 255, (n, size, size, 3)).astype(np.uint8),
+            rng.integers(0, 10, (n,)).astype(np.int32),
+            np.ones((n,), bool))
+
+
+# -- policy semantics --------------------------------------------------
+
+def test_presets_dtype_table():
+    f32 = get_policy("f32")
+    assert (f32.param_dtype, f32.compute_dtype, f32.accum_dtype) \
+        == (jnp.float32, jnp.float32, jnp.float32)
+    bf16 = get_policy("bf16")
+    assert bf16.param_dtype == jnp.float32          # f32 masters
+    assert bf16.compute_dtype == jnp.bfloat16
+    assert bf16.accum_dtype == jnp.float32
+    full = get_policy("bf16_full")
+    assert full.param_dtype == jnp.bfloat16
+    assert full.accum_dtype == jnp.float32          # accum stays f32
+    f16 = get_policy("f16")
+    assert f16.scales_loss and f16.loss_scale == 2.0 ** 15
+    # every preset guarantees f32 accumulation
+    assert all(p.accum_dtype == jnp.float32 for p in PRESETS.values())
+
+
+def test_from_flags_precedence_and_compat():
+    assert from_flags("bf16_full", False).name == "bf16_full"  # wins
+    assert from_flags(None, True).name == "bf16"    # historical default
+    assert from_flags(None, False).name == "f32"
+    with pytest.raises(ValueError):
+        get_policy("fp8")
+
+
+def test_param_dtypes_follow_policy():
+    for preset, want in (("f32", jnp.float32), ("bf16", jnp.float32),
+                         ("bf16_full", jnp.bfloat16),
+                         ("f16", jnp.float32)):
+        _, state = _engine(preset=preset)
+        dts = {leaf.dtype for leaf in
+               jax.tree_util.tree_leaves(state.params)}
+        assert dts == {jnp.dtype(want)}, (preset, dts)
+
+
+# -- fused step --------------------------------------------------------
+
+def test_fused_step_equals_unfused_bitwise_f32():
+    imgs, labels, valid = _batch()
+    key = jax.random.PRNGKey(5)
+    eng_f, st_f = _engine()
+    eng_u, st_u = _engine()
+    for _ in range(3):
+        st_f, m_f = eng_f.train_step(st_f, imgs, labels, valid, key)
+        st_u, m_u = eng_u.train_step_unfused(st_u, imgs, labels, valid,
+                                             key)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(st_f.params)),
+                    jax.tree_util.tree_leaves(jax.device_get(st_u.params))):
+        assert np.array_equal(np.asarray(a).view(np.uint8),
+                              np.asarray(b).view(np.uint8))
+    assert float(m_f["loss"]) == float(m_u["loss"])
+
+
+def test_unfused_rejects_grad_accum():
+    eng, state = _engine(grad_accum=2)
+    imgs, labels, valid = _batch()
+    with pytest.raises(ValueError, match="grad_accum"):
+        eng.train_step_unfused(state, imgs, labels, valid,
+                               jax.random.PRNGKey(0))
+
+
+def test_grad_accum_matches_single_shot():
+    """K=2 microbatches over the same samples == one big batch (f32:
+    the accumulation is exact up to summation order)."""
+    imgs, labels, valid = _batch(n=8)
+    key = jax.random.PRNGKey(5)
+    eng1, st1 = _engine(grad_accum=1, optimizer="SGD")
+    st1, m1 = eng1.train_step(st1, imgs, labels, valid, key)
+    eng2, st2 = _engine(grad_accum=2, optimizer="SGD")
+    st2, m2 = eng2.train_step(st2, imgs, labels, valid, key)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(st1.params)),
+                    jax.tree_util.tree_leaves(jax.device_get(st2.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-6)
+
+
+# -- remat -------------------------------------------------------------
+
+def test_remat_blocks_grads_allclose_vit():
+    """--remat blocks wraps the zoo's block boundaries in jax.checkpoint;
+    recomputation must not change the gradients (same params: the
+    explicit block names keep the tree identical)."""
+    assert "vit" in REMAT_BLOCK_MODELS
+    pol = get_policy("f32")
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        0, 1, (2, 32, 32, 3)), jnp.float32)
+
+    def grads_for(remat):
+        model = get_model("vit", 10, precision=pol, remat=remat)
+        variables = model.init({"params": jax.random.PRNGKey(0)}, x,
+                               train=False)
+
+        def loss(params):
+            out = model.apply({"params": params}, x, train=True,
+                              rngs={"dropout": jax.random.PRNGKey(1)})
+            logits = out[0] if isinstance(out, tuple) else out
+            return jnp.sum(logits.astype(jnp.float32) ** 2)
+
+        return variables["params"], jax.grad(loss)(variables["params"])
+
+    p0, g0 = grads_for("none")
+    p1, g1 = grads_for("blocks")
+    assert jax.tree_util.tree_structure(p0) \
+        == jax.tree_util.tree_structure(p1)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_remat_full_train_step_matches_none():
+    imgs, labels, valid = _batch()
+    key = jax.random.PRNGKey(9)
+    eng_n, st_n = _engine(remat="none", optimizer="SGD")
+    eng_r, st_r = _engine(remat="full", optimizer="SGD")
+    st_n, m_n = eng_n.train_step(st_n, imgs, labels, valid, key)
+    st_r, m_r = eng_r.train_step(st_r, imgs, labels, valid, key)
+    np.testing.assert_allclose(float(m_n["loss"]), float(m_r["loss"]),
+                               rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(st_n.params)),
+                    jax.tree_util.tree_leaves(jax.device_get(st_r.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_remat_choice_validated():
+    with pytest.raises(ValueError, match="remat"):
+        _engine(remat="everything")
+
+
+# -- loss scaling ------------------------------------------------------
+
+def test_loss_scale_overflow_skips_update_but_advances_step():
+    eng, state = _engine(preset="f16")
+    assert state.loss_scale is not None
+    scale0 = float(state.loss_scale.scale)
+    inf_grads = jax.tree_util.tree_map(
+        lambda p: jnp.full(p.shape, jnp.inf, p.dtype), state.params)
+    zeros_bs = state.batch_stats
+    new_state, _ = eng._finish_step(state, inf_grads, zeros_bs,
+                                    jnp.zeros(()), jnp.zeros(()),
+                                    jnp.ones((8,)))
+    # params and opt state untouched, scale halved, step advanced
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(new_state.params)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert float(new_state.loss_scale.scale) == scale0 / 2
+    assert int(new_state.step) == int(state.step) + 1
+
+
+def test_loss_scale_growth_and_floor():
+    ls = LossScaleState.create(4.0)
+    for _ in range(2):
+        ls = ls.adjust(jnp.asarray(True), growth_interval=2)
+    assert float(ls.scale) == 8.0           # doubled at the interval
+    for _ in range(10):
+        ls = ls.adjust(jnp.asarray(False), growth_interval=2)
+    assert float(ls.scale) >= 1.0           # floored, never 0
+
+
+def test_all_finite_and_tree_select():
+    good = {"a": jnp.ones((2,)), "b": jnp.zeros((3,))}
+    bad = {"a": jnp.array([1.0, jnp.nan]), "b": jnp.zeros((3,))}
+    assert bool(all_finite(good)) and not bool(all_finite(bad))
+    sel = tree_select(jnp.asarray(False), good, bad)
+    assert np.isnan(np.asarray(sel["a"])).any()
+
+
+def test_f16_train_step_runs_and_keeps_finite_loss():
+    imgs, labels, valid = _batch()
+    eng, state = _engine(preset="f16")
+    state, metrics = eng.train_step(state, imgs, labels, valid,
+                                    jax.random.PRNGKey(2))
+    assert np.isfinite(float(metrics["loss"]))
+    assert float(state.loss_scale.scale) > 0
+
+
+# -- config / CLI / checkpoint round trip ------------------------------
+
+def test_cli_precision_flags_round_trip():
+    cfg = config_from_argv(["train", "-d", "/nodata", "--precision",
+                            "bf16_full", "--remat", "blocks"])
+    assert cfg.precision == "bf16_full" and cfg.remat == "blocks"
+    assert cfg.precision_policy().name == "bf16_full"
+    # legacy flag still works and maps through from_flags
+    cfg2 = config_from_argv(["train", "-d", "/nodata", "--no-bf16"])
+    assert cfg2.precision is None
+    assert cfg2.precision_policy().name == "f32"
+    # programmatic Config default: half_precision=True -> bf16
+    assert Config(action="train",
+                  data_path="/nodata").precision_policy().name == "bf16"
+
+
+def test_checkpoint_round_trip_preserves_param_dtype(tmp_path):
+    """A bf16_full checkpoint restored into a bf16_full template keeps
+    bf16 params (the policy, not the serializer, owns param_dtype)."""
+    eng, state = _engine(preset="bf16_full")
+    imgs, labels, valid = _batch()
+    state, _ = eng.train_step(state, imgs, labels, valid,
+                              jax.random.PRNGKey(1))
+    path = os.path.join(str(tmp_path), "ckpt-test.ckpt")
+    ckpt.save_checkpoint(path, "mlp", state, epoch=0,
+                         best_valid_loss=1.0)
+    _, template = _engine(preset="bf16_full")
+    restored_state, _, _ = ckpt.load_checkpoint(path, template)
+    dts = {leaf.dtype for leaf in
+           jax.tree_util.tree_leaves(restored_state.params)}
+    assert dts == {jnp.dtype(jnp.bfloat16)}
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(state.params)),
+                    jax.tree_util.tree_leaves(
+                        jax.device_get(restored_state.params))):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_from_scaling_run_restores_into_nonscaling(tmp_path):
+    """An f16 checkpoint (carries a LossScaleState) restored into an f32
+    template drops the scale; an f32 checkpoint restored into an f16
+    template keeps the template's fresh scale — both directions load."""
+    eng16, st16 = _engine(preset="f16")
+    imgs, labels, valid = _batch()
+    st16, _ = eng16.train_step(st16, imgs, labels, valid,
+                               jax.random.PRNGKey(1))
+    p16 = os.path.join(str(tmp_path), "f16.ckpt")
+    ckpt.save_checkpoint(p16, "mlp", st16, epoch=0, best_valid_loss=1.0)
+    _, tmpl32 = _engine(preset="f32")
+    restored_state, _, _ = ckpt.load_checkpoint(p16, tmpl32)
+    assert restored_state.loss_scale is None
+
+    eng32, st32 = _engine(preset="f32")
+    st32, _ = eng32.train_step(st32, imgs, labels, valid,
+                               jax.random.PRNGKey(1))
+    p32 = os.path.join(str(tmp_path), "f32.ckpt")
+    ckpt.save_checkpoint(p32, "mlp", st32, epoch=0, best_valid_loss=1.0)
+    _, tmpl16 = _engine(preset="f16")
+    restored16, _, _ = ckpt.load_checkpoint(p32, tmpl16)
+    assert restored16.loss_scale is not None
+    assert float(restored16.loss_scale.scale) == 2.0 ** 15
