@@ -1180,6 +1180,70 @@ class MixedPrecisionAccum(Rule):
                         f"in f32 and cast once at the end")
 
 
+# -- 12. collective-in-cleanup ----------------------------------------
+
+class CollectiveInCleanup(Rule):
+    """A collective in an ``except``/``finally`` block is a deadlock
+    trap: cleanup paths are exactly where ranks DIVERGE — one rank got
+    here through a failure its peers didn't see, so the peers are not
+    in (and may never reach) the matching collective, and the cleanup
+    hangs on the very condition it was cleaning up after.  This is the
+    failure mode the elastic teardown is built around (elastic.py:
+    survivors must never run a barrier the dead rank can't join — the
+    jaxlib shutdown barrier is the canonical offender).  Failure paths
+    must be collective-free, or first re-establish agreement through a
+    bounded mechanism (runtime.agree_health with --health-timeout).
+    Deliberate exceptions carry a rationale comment on the call line or
+    the line above, same contract as bare-except."""
+
+    name = "collective-in-cleanup"
+    description = ("collective call inside except/finally — peers that "
+                   "didn't take this path never reach it (deadlock)")
+
+    # Cross-rank rendezvous: jax.lax collectives, multihost_utils
+    # helpers, and this repo's own agreement wrappers (runtime.py).
+    COLLECTIVES = {
+        "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+        "ppermute", "psum_scatter", "process_allgather",
+        "sync_global_devices", "broadcast_one_to_all",
+        "host_local_array_to_global_array",
+        "global_array_to_host_local_array", "barrier", "agree_health",
+        "any_process",
+    }
+
+    def _has_rationale(self, mod: Module, line: int) -> bool:
+        return mod.has_comment(line) or (line - 1) in mod.comment_lines
+
+    def _cleanup_bodies(self, tree: ast.AST
+                        ) -> Iterator[Tuple[str, List[ast.stmt]]]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Try):
+                for handler in node.handlers:
+                    yield "except", handler.body
+                if node.finalbody:
+                    yield "finally", node.finalbody
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            for where, body in self._cleanup_bodies(mod.tree):
+                for stmt in body:
+                    for call in walk_calls(stmt):
+                        if last_seg(call_name(call)) \
+                                not in self.COLLECTIVES:
+                            continue
+                        if self._has_rationale(mod, call.lineno):
+                            continue
+                        yield self.finding(
+                            mod, call.lineno,
+                            f"{call_name(call)}() inside a {where} "
+                            f"block: a rank that didn't take this "
+                            f"path never reaches the matching "
+                            f"collective and this cleanup deadlocks "
+                            f"— move it before the try, gate it on "
+                            f"agreement, or comment why every rank "
+                            f"provably gets here")
+
+
 RULES = (
     HostSyncInStepLoop(),
     TraceImpurity(),
@@ -1192,6 +1256,7 @@ RULES = (
     RetryWithoutBackoff(),
     ProfilerTraceLeak(),
     MixedPrecisionAccum(),
+    CollectiveInCleanup(),
 )
 
 RULES_BY_NAME = {r.name: r for r in RULES}

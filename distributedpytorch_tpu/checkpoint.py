@@ -277,6 +277,24 @@ def list_checkpoints(rsl_path: str, dataset: str,
     return [p for _, p in sorted(found, reverse=True)]
 
 
+def newest_checkpoint(rsl_path: str, dataset: str,
+                      model_name: str) -> Optional[str]:
+    """Path of the newest rolling snapshot, or None when there is none.
+
+    The elastic resume entry point (cli.py reconfigure path): survivors
+    of a rank loss restore from here after re-initializing the smaller
+    world.  This works across a WORLD-SIZE CHANGE by construction —
+    snapshots are written from ``gather_replicated`` state
+    (fully-replicated host arrays, no per-rank sharding in the file),
+    so a checkpoint written by N ranks restores bit-identically into
+    N-1; only the data sharding is re-derived, by the loader.
+    Verification (lineage checksum) happens downstream in
+    ``load_checkpoint_with_fallback`` — this just names the head.
+    """
+    ckpts = list_checkpoints(rsl_path, dataset, model_name)
+    return ckpts[0] if ckpts else None
+
+
 def load_checkpoint_with_fallback(path: str, state: TrainState,
                                   rsl_path: str, dataset: str,
                                   model_name: str,

@@ -20,7 +20,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import checkpoint as ckpt
-from . import costs, faults, flightrec, parallel, runtime, telemetry, utils
+from . import costs, elastic, faults, flightrec, parallel, runtime, \
+    telemetry, utils
 from .config import Config, config_from_argv
 from .data import augment  # noqa: F401  (re-exported for drivers/tests)
 from .data.datasets import Dataset, Split, load_dataset
@@ -556,7 +557,7 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
         # (documented trade-off of --epochs-per-dispatch).
         except Exception as e:
             chunk_err = e
-        if _health_boundary(tel, shutdown, chunk[-1], chunk_err):
+        if _health_boundary(tel, shutdown, chunk[-1], chunk_err, cfg):
             break
     return {"history": history, "best_valid_loss": best_valid_loss,
             "model_name": model_name, "state": state,
@@ -569,7 +570,7 @@ def run_train(cfg: Config) -> dict:
     # live for the initialize call itself.
     faults.configure(cfg.fault_plan, cfg.fault_seed, cfg.retry_max_attempts,
                      cfg.retry_base_delay, cfg.retry_timeout)
-    runtime.initialize_distributed()
+    runtime.initialize_distributed(elastic=cfg.elastic)
     utils.initialize_logging(cfg.rsl_path, cfg.log_file,
                              truncate=runtime.is_main())
     # After distributed init so the rank in the filename is the GLOBAL
@@ -744,6 +745,105 @@ def run_train(cfg: Config) -> dict:
     valid_loader = _make_loader(cfg, dataset.splits["valid"], mesh,
                                 shuffle=False)
 
+    # Degrade mode: a background-writer failure downgrades the run to
+    # synchronous saves (loud log + ckpt_async_degraded event) instead of
+    # killing a healthy training loop at the next join.
+    saver = (ckpt.AsyncSaver(on_error="degrade")
+             if cfg.ckpt_async else None)
+    start_time = utils.monotonic()
+    shutdown = utils.GracefulShutdown()
+    resume_file = cfg.checkpoint_file
+    reconfigures = 0
+    try:
+        with shutdown:
+            # The elastic retraining loop: one iteration per collective
+            # world.  Without --elastic a WorldChangedError is never
+            # raised and this runs the body exactly once, as before.
+            while True:
+                try:
+                    return _train_world(cfg, model_name, dataset, mesh,
+                                        train_loader, valid_loader,
+                                        resume_file, start_time, shutdown,
+                                        saver)
+                except elastic.WorldChangedError as e:
+                    reconfigures += 1
+                    if reconfigures > cfg.max_reconfigures:
+                        raise faults.PeerFailureError(
+                            f"world shrank {reconfigures} times, over "
+                            f"the --max-reconfigures {cfg.max_reconfigures}"
+                            " cap; exiting with the last failure") from e
+                    # Release everything that pins the old backend —
+                    # the exception chain's tracebacks (their frames
+                    # hold the old world's state/batches), the mesh,
+                    # and the loaders' device handles — so the
+                    # reconfigure below can destroy it.  Destruction
+                    # closes our gloo sockets, the only wake-up signal
+                    # a peer still blocked in a collective on the dead
+                    # world ever gets (elastic.py module doc).
+                    exc = e
+                    while exc is not None:
+                        exc.__traceback__ = None
+                        exc = exc.__cause__ or exc.__context__
+                    mesh = None
+                    if isinstance(train_loader, ShardedLoader):
+                        train_loader.release()
+                        valid_loader.release()
+                    else:  # resident loaders ARE device arrays; rebuilt
+                        train_loader = valid_loader = None
+                # Reconfigure OUTSIDE the except block: the interpreter
+                # exception state (sys.exc_info) holds the traceback
+                # until the block exits, defeating the release above.
+                mesh = _elastic_reconfigure(cfg, tel, saver)
+                if isinstance(train_loader, ShardedLoader):
+                    # Deterministic reshard: same split/settings,
+                    # re-derived rank slices for the new world.
+                    train_loader = train_loader.reshard(mesh)
+                    valid_loader = valid_loader.reshard(mesh)
+                else:  # resident loaders re-place onto the new mesh
+                    train_loader = _make_loader(
+                        cfg, dataset.splits["train"], mesh,
+                        shuffle=True)
+                    valid_loader = _make_loader(
+                        cfg, dataset.splits["valid"], mesh,
+                        shuffle=False)
+                # Resume from the newest lineage-verified snapshot;
+                # None (died before the first save) restarts from
+                # initialization — same as a fresh launch.
+                resume_file = ckpt.newest_checkpoint(
+                    cfg.rsl_path, cfg.dataset, model_name)
+    finally:
+        # Join pending background checkpoint writes FIRST (their spans
+        # must land before the close below; a preempted/finished run must
+        # not exit with a half-written rolling file), then emit the
+        # counter/histogram summaries — also on an exception path, so a
+        # killed run still leaves a readable telemetry trail.
+        try:
+            if saver is not None:
+                saver.close()
+        finally:
+            # Flight-record dump BEFORE the telemetry close so a crash
+            # leaves both trails; sys.exc_info distinguishes the crash
+            # dump from the ordinary end-of-run one.
+            flightrec.get().close(
+                "crash" if sys.exc_info()[0] is not None else "run_end")
+            tel.close()
+            runtime.reset_compilation_cache()
+
+
+def _train_world(cfg: Config, model_name: str, dataset: Dataset, mesh,
+                 train_loader, valid_loader, resume_file, start_time,
+                 shutdown, saver) -> dict:
+    """Build engine+state for ONE collective world and train to the end.
+
+    Everything here is world-shaped — engine (mesh-aware models), state
+    placement, the epoch driver — so the elastic loop in ``run_train``
+    can rerun it wholesale after a shrink.  ``resume_file`` is the
+    -f/--file argument on the first world and the newest rolling
+    snapshot after a reconfigure (None = fresh init, including the
+    --use-pretrained path).
+    """
+    tel = telemetry.get()
+    world = runtime.world_size()
     use_chunks = (cfg.epochs_per_dispatch > 1
                   and isinstance(train_loader, ResidentLoader)
                   and isinstance(valid_loader, ResidentLoader))
@@ -759,14 +859,14 @@ def run_train(cfg: Config) -> dict:
     # The resolved policy is part of the run's record: the precision gate
     # (scripts/precision_gate.py) reads this event back to assert the
     # accumulators really are f32 under the half-precision presets.
-    telemetry.get().event("precision_policy", remat=cfg.remat,
-                          grad_accum=cfg.grad_accum,
-                          **engine.precision.describe())
+    tel.event("precision_policy", remat=cfg.remat,
+              grad_accum=cfg.grad_accum,
+              **engine.precision.describe())
     root = utils.root_key(cfg.seed)
     state = engine.init_state(root)
 
-    if cfg.checkpoint_file:
-        if os.path.isdir(cfg.checkpoint_file):
+    if resume_file:
+        if os.path.isdir(resume_file):
             # orbax: place the template FIRST so the restore lands
             # straight in the final (possibly model-sharded) layout —
             # no transient fully-replicated copy of a state that may
@@ -775,9 +875,12 @@ def run_train(cfg: Config) -> dict:
         # Lineage-aware resume: a torn/corrupt head checkpoint falls back
         # (loudly) to the newest snapshot that verifies, instead of
         # killing the restart loop on the very file a crash mangled.
+        # Elastic resume rides the same path: snapshots are replicated
+        # host state, so a file written by the LARGER world restores
+        # bit-identically here (ckpt.newest_checkpoint).
         state, start_epoch, best_valid_loss = \
             ckpt.load_checkpoint_with_fallback(
-                cfg.checkpoint_file, state, cfg.rsl_path, cfg.dataset,
+                resume_file, state, cfg.rsl_path, cfg.dataset,
                 model_name)
         state = _place_state(state, mesh, cfg)
     else:
@@ -800,55 +903,114 @@ def run_train(cfg: Config) -> dict:
         _aot_warmup(cfg, engine, state, train_loader, valid_loader, root,
                     start_epoch)
 
-    # Degrade mode: a background-writer failure downgrades the run to
-    # synchronous saves (loud log + ckpt_async_degraded event) instead of
-    # killing a healthy training loop at the next join.
-    saver = (ckpt.AsyncSaver(on_error="degrade")
-             if cfg.ckpt_async else None)
-    start_time = utils.monotonic()
-    shutdown = utils.GracefulShutdown()
-    try:
-        with shutdown:
-            if use_chunks:
-                return _run_train_chunked(cfg, engine, state, train_loader,
-                                          valid_loader, model_name, root,
-                                          start_epoch, best_valid_loss,
-                                          start_time, world, shutdown,
-                                          saver)
-            return _run_train_epochs(cfg, engine, state, train_loader,
-                                     valid_loader, model_name, root,
-                                     start_epoch, best_valid_loss,
-                                     start_time, world, shutdown, saver)
-    finally:
-        # Join pending background checkpoint writes FIRST (their spans
-        # must land before the close below; a preempted/finished run must
-        # not exit with a half-written rolling file), then emit the
-        # counter/histogram summaries — also on an exception path, so a
-        # killed run still leaves a readable telemetry trail.
+    if use_chunks:
+        return _run_train_chunked(cfg, engine, state, train_loader,
+                                  valid_loader, model_name, root,
+                                  start_epoch, best_valid_loss,
+                                  start_time, world, shutdown, saver)
+    return _run_train_epochs(cfg, engine, state, train_loader,
+                             valid_loader, model_name, root,
+                             start_epoch, best_valid_loss,
+                             start_time, world, shutdown, saver)
+
+
+def _elastic_reconfigure(cfg: Config, tel, saver):
+    """Shrink into the surviving world; returns the new mesh.
+
+    Sequence (each step's rationale in elastic.py): drain pending async
+    checkpoint writes (the newest snapshot is what the new world resumes
+    from), dump the flight recorder (the departed rank's last minutes
+    are the post-mortem), rendezvous + re-init the collective runtime,
+    then rebuild the mesh against the new backend.  Telemetry keeps the
+    ORIGINAL rank file — stable per-process streams are what the
+    timeline merger aligns on across the reconfigure boundary.
+    """
+    if saver is not None:
         try:
-            if saver is not None:
-                saver.close()
-        finally:
-            # Flight-record dump BEFORE the telemetry close so a crash
-            # leaves both trails; sys.exc_info distinguishes the crash
-            # dump from the ordinary end-of-run one.
-            flightrec.get().close(
-                "crash" if sys.exc_info()[0] is not None else "run_end")
-            tel.close()
-            runtime.reset_compilation_cache()
+            saver.wait()
+        except Exception as e:
+            # A failed background save must not block the reconfigure:
+            # lineage verification skips the bad file on restore.
+            logging.error(f"async checkpoint flush failed during "
+                          f"reconfigure (continuing): {e}")
+    flightrec.get().dump("reconfigure")
+    old_rank = runtime.process_index()
+    old_world = runtime.process_count()
+    elastic_dir = cfg.elastic_dir or elastic.default_elastic_dir(
+        cfg.rsl_path)
+    info = elastic.reconfigure(elastic_dir, old_rank, old_world)
+    tel.event("elastic/reconfigure", generation=info["generation"],
+              old_world=old_world, new_world=info["new_world"],
+              old_rank=old_rank, new_rank=info["new_rank"],
+              coordinator=info["coordinator"])
+    tel.gauge("elastic/world_size").set(info["new_world"])
+    tel.flush()
+    flightrec.get().record_event("elastic_reconfigure",
+                                 generation=info["generation"],
+                                 new_world=info["new_world"])
+    return runtime.make_mesh(model_parallel=cfg.model_parallel,
+                             seq_parallel=cfg.seq_parallel)
 
 
-def _health_boundary(tel, shutdown, epoch: int, err) -> bool:
+def _peer_loss_exit(tel, epoch: int, err, elastic_on: bool):
+    """A peer is GONE — dead transport mid-collective or a timed-out
+    health agreement.  Under --elastic this is the reconfigure signal;
+    otherwise it is the pre-elastic coordinated exit, minus the hang.
+    Always raises."""
+    tel.event("peer_loss", epoch=epoch, elastic=elastic_on,
+              error=repr(err))
+    tel.flush()
+    if elastic_on:
+        # No flight dump here: _elastic_reconfigure dumps with reason
+        # "reconfigure" once the shrink actually starts.
+        raise elastic.WorldChangedError(
+            f"peer lost during epoch {epoch + 1}: {err}") from err
+    flightrec.get().dump("peer_failure")
+    raise faults.PeerFailureError(
+        f"a peer process vanished during epoch {epoch + 1} ({err}); "
+        "exiting") from err
+
+
+def _health_boundary(tel, shutdown, epoch: int, err, cfg=None) -> bool:
     """Epoch/chunk-boundary failure agreement.  ONE allgather carries
     both the fatal flag and the shutdown flag (runtime.agree_health), so
     the collective schedule on healthy ranks is unchanged from the old
     shutdown-only check.  A rank that failed host-side re-raises its own
     error; its peers raise PeerFailureError — every rank exits together,
-    none hangs waiting in a later collective.  Returns True when the run
-    should stop cleanly (preemption)."""
+    none hangs waiting in a later collective.  Under --elastic a peer
+    VANISHING (vs failing and reporting) becomes WorldChangedError — the
+    signal for run_train's elastic loop to shrink and resume — and
+    --health-timeout bounds the agreement itself so a dead peer that
+    never reaches this boundary yields a local verdict instead of a
+    deadlock.  Returns True when the run should stop cleanly
+    (preemption)."""
+    elastic_on = bool(cfg is not None and cfg.elastic)
     tel.flush()  # boundary: buffered events hit the disk
-    any_failed, any_shutdown = runtime.agree_health(
-        err is not None, shutdown.requested)
+    if elastic.is_peer_loss(err):
+        # The epoch itself died INSIDE a collective: the transport to
+        # the dead peer is gone, so the agreement allgather below would
+        # ride the same broken channel.  The local error is the verdict.
+        _peer_loss_exit(tel, epoch, err, elastic_on)
+    timeout_s = (cfg.health_timeout if cfg is not None else 0.0) or None
+    try:
+        any_failed, any_shutdown = runtime.agree_health(
+            err is not None, shutdown.requested, timeout_s=timeout_s)
+    except faults.HealthTimeoutError as timeout_err:
+        # Bounded failure detection: the peer died BETWEEN collectives
+        # and never reached this boundary — without the bound the
+        # allgather blocks forever on it.
+        tel.event("health_timeout", epoch=epoch, timeout_s=timeout_s)
+        tel.flush()
+        if err is not None:
+            raise err  # the local failure outranks the missing peer
+        _peer_loss_exit(tel, epoch, timeout_err, elastic_on)
+    # Broad on purpose: the transport surfaces a dead peer as ValueError
+    # (gloo) but backend wrappers vary; anything non-peer-loss re-raises.
+    except Exception as agree_err:
+        if err is None and elastic.is_peer_loss(agree_err):
+            # The agreement's own transport hit the dead peer first.
+            _peer_loss_exit(tel, epoch, agree_err, elastic_on)
+        raise err if err is not None else agree_err
     # The allgather above returns at (nearly) the same real instant on
     # every rank, so this event's paired ts+mono stamps are the timeline
     # merger's cross-rank clock-alignment points (timeline.py).
@@ -865,6 +1027,11 @@ def _health_boundary(tel, shutdown, epoch: int, err) -> bool:
         flightrec.get().dump("peer_failure")
         if err is not None:
             raise err
+        if elastic_on:
+            # The failed rank reported, agreed, and is exiting; the
+            # healthy remainder reconfigures around the hole it leaves.
+            raise elastic.WorldChangedError(
+                f"a peer reported failure during epoch {epoch + 1}")
         raise faults.PeerFailureError(
             f"a peer process failed during epoch {epoch + 1}; exiting "
             "with it (health agreement)")
@@ -970,7 +1137,7 @@ def _run_train_epochs(cfg: Config, engine: Engine, state, train_loader,
         # allgather on every rank — handling happens in _health_boundary.
         except Exception as e:
             epoch_err = e
-        if _health_boundary(tel, shutdown, epoch, epoch_err):
+        if _health_boundary(tel, shutdown, epoch, epoch_err, cfg):
             break
     # Final state is returned so callers (multi-process tests, notebooks)
     # can inspect the trained parameters without re-reading a checkpoint.
@@ -1085,6 +1252,7 @@ def main(argv=None) -> int:
             return 1
         return 0
     print("========================= start =========================")
+    rc = 0
     try:
         if cfg.action == "train":
             run_train(cfg)
@@ -1092,15 +1260,22 @@ def main(argv=None) -> int:
             run_test(cfg)
     except ValueError as e:  # ref style: log and exit (classif.py:119,130)
         logging.error(f"{e}, exiting...")
-        return 1
-    except (faults.FatalFaultError, faults.PeerFailureError) as e:
+        rc = 1
+    except (faults.FatalFaultError, faults.PeerFailureError,
+            faults.HealthTimeoutError) as e:
         # Agreed-upon fatal exit: every rank takes this path together
         # (see _health_boundary), so the nonzero status is coordinated
         # rather than one rank dying and the rest hanging.
         logging.error(f"fatal failure: {e}, exiting...")
-        return 1
-    print("========================= end ==========================")
-    return 0
+        rc = 1
+    if rc == 0:
+        print("========================= end ==========================")
+    if elastic.reconfigured():
+        # A reconfigured process must not run interpreter teardown: the
+        # parked pre-shrink coordinator service fatals when the GC
+        # finally destroys it (elastic.py module doc).  Flush and leave.
+        elastic.quiesce_exit(rc)
+    return rc
 
 
 if __name__ == "__main__":

@@ -197,6 +197,17 @@ class Config:
     retry_max_attempts: int = 3
     retry_base_delay: float = 0.05
     retry_timeout: float = 60.0
+    # Elastic training (elastic.py, ISSUE 10): survive rank loss by
+    # reconfiguring into the surviving world instead of exiting at the
+    # failure agreement.  elastic_dir is the shared rendezvous dir
+    # (default RSL_PATH/elastic); health_timeout bounds the boundary
+    # agree_health allgather so a dead peer becomes a local verdict
+    # instead of a deadlock (0 = unbounded, the pre-elastic behavior);
+    # max_reconfigures caps shrink rounds per process.
+    elastic: bool = False
+    elastic_dir: Optional[str] = None
+    health_timeout: float = 0.0
+    max_reconfigures: int = 3
     # Rolling-checkpoint lineage depth: how many per-epoch snapshots are
     # retained (1 = the reference delete-previous behavior; >1 gives the
     # corruption-fallback resume earlier snapshots to walk back to).
@@ -328,8 +339,9 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                         "(';'-separated, e.g. 'data.read:ioerror:2') or a "
                         "JSON plan file; sites: data.read data.host_batch "
                         "ckpt.save ckpt.finalize ckpt.restore runtime.init "
-                        "telemetry.write; kinds: ioerror fatal preempt "
-                        "torn stall (default: no faults, zero overhead)")
+                        "elastic.reinit telemetry.write; kinds: ioerror "
+                        "fatal preempt torn stall rank_loss (default: no "
+                        "faults, zero overhead)")
     p.add_argument("--fault-seed", type=int, default=0, dest="faultSeed",
                    metavar="S",
                    help="seed for the fault plan + deterministic retry "
@@ -349,6 +361,32 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                    help="per-site wall-clock retry deadline: no new "
                         "attempt starts after this many seconds "
                         "(default 60)")
+    p.add_argument("--elastic", action="store_true",
+                   help="survive rank loss: on peer failure the healthy "
+                        "ranks checkpoint state they hold, re-elect a "
+                        "coordinator, re-init jax.distributed as the "
+                        "smaller surviving world and resume from the "
+                        "newest verified checkpoint (see elastic.py; "
+                        "coordinator loss is not survivable)")
+    p.add_argument("--elastic-dir", type=str, default=None,
+                   dest="elasticDir", metavar="DIR",
+                   help="shared rendezvous directory for --elastic "
+                        "(claim files + world.json; default "
+                        "RSL_PATH/elastic — already shared, the "
+                        "checkpoints live there)")
+    p.add_argument("--health-timeout", type=float, default=0.0,
+                   dest="healthTimeout", metavar="SEC",
+                   help="bound the boundary health agreement: if the "
+                        "agree_health allgather does not complete in "
+                        "SEC seconds, treat it as a peer loss locally "
+                        "(reconfigure under --elastic, exit loudly "
+                        "otherwise) instead of hanging on a dead rank "
+                        "(default 0 = unbounded)")
+    p.add_argument("--max-reconfigures", type=int, default=3,
+                   dest="maxReconfigures", metavar="N",
+                   help="cap on elastic shrink rounds per process; "
+                        "exceeding it exits with the underlying error "
+                        "(default 3)")
     p.add_argument("--keep-ckpts", type=int, default=1, dest="keepCkpts",
                    metavar="K",
                    help="rolling-checkpoint lineage depth: retain the K "
@@ -581,6 +619,10 @@ def config_from_argv(argv=None) -> Config:
         retry_max_attempts=args.retryMaxAttempts,
         retry_base_delay=args.retryBaseDelay,
         retry_timeout=args.retryTimeout,
+        elastic=args.elastic,
+        elastic_dir=args.elasticDir,
+        health_timeout=args.healthTimeout,
+        max_reconfigures=args.maxReconfigures,
         keep_ckpts=args.keepCkpts,
         compilation_cache_dir=args.compilationCacheDir,
         no_compile_cache=args.noCompileCache,

@@ -137,6 +137,8 @@ class ShardedLoader:
         self.split = split
         self.mesh = mesh
         self.batch_per_replica = batch_per_replica
+        self.shuffle = shuffle
+        self.seed = seed
         # prefetch=0: strictly synchronous put->step alternation.  On the
         # virtual-CPU test mesh an H2D transfer still in flight while an
         # 8-participant all-reduce executes can deadlock XLA:CPU's
@@ -201,6 +203,33 @@ class ShardedLoader:
         if isinstance(q, list):  # threaded path: per-producer queues
             return sum(x.qsize() for x in q)
         return len(q)  # synchronous path: one deque
+
+    def release(self) -> None:
+        """Drop every device-backed reference — mesh, sharding, prefetch
+        queues (their entries are device batches) — keeping only the
+        plain-host fields ``reshard`` needs.  Elastic pre-teardown
+        (cli.run_train): the old world's backend cannot be destroyed,
+        and its gloo sockets closed, while loader state pins it."""
+        self.mesh = None
+        self.sharding = None
+        self._queues.clear()
+
+    def reshard(self, mesh: Mesh) -> "ShardedLoader":
+        """A fresh loader over the SAME split/settings on a NEW mesh —
+        the elastic reconfigure path (cli.py): after a world shrink the
+        rank space changes size, so every sampler must be re-derived.
+        Because shard assignment is a pure function of
+        (num_samples, world, rank, seed, epoch) — one global epoch-keyed
+        permutation, rank slice ``perm[rank::world]`` — the re-derived
+        loader enumerates exactly the full dataset for the new world,
+        identically to a loader BORN at that world size (property-tested
+        in tests/test_elastic.py).  No state carries over: epoch
+        generators and prefetch queues belong to the old world.
+        """
+        return ShardedLoader(self.split, mesh, self.batch_per_replica,
+                             shuffle=self.shuffle, seed=self.seed,
+                             prefetch=self.prefetch,
+                             producer_threads=self.producer_threads)
 
     def __len__(self) -> int:
         return self.batches_per_epoch

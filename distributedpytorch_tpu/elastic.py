@@ -1,0 +1,602 @@
+"""L1: elastic world manager — survive rank loss, resume shrunken.
+
+The PR-3/PR-4 failure machinery AGREES on failure (agree_health) and
+exits every rank at the same boundary.  That turns a hang into a clean
+crash; the job is still dead.  This module (``--elastic``) turns the
+same verdict into a reconfiguration: the surviving ranks tear down the
+collective runtime, re-elect a coordinator among themselves, re-init
+``jax.distributed`` as a smaller world, rebuild the mesh, and resume
+from the newest lineage-verified checkpoint.  Rank loss costs the work
+since the last checkpoint — not the job.
+
+How teardown actually works on this jaxlib (validated empirically on
+jaxlib 0.4.36, CPU+gloo; every choice below is load-bearing):
+
+* ``jax.distributed.shutdown()`` is UNUSABLE on survivors: it runs the
+  shutdown barrier, which can't complete with a dead peer, and the
+  client's default error callback terminates the process from a C++
+  thread (xla distributed_runtime_client: no Python except can catch
+  it).  So the runtime client is created by hand with
+  ``shutdown_on_destruction=False`` and is never shut down.
+* The old client and (on the old coordinator) the old service are
+  deliberately LEAKED into ``_parked``: destroying the service closes
+  the socket that still-live gloo poll threads watch, which is an
+  unoverridable fatal; the gloo KV-store closures hold client refs
+  anyway.  A leaked generation costs a few buffers and two idle
+  threads — a reconfigure is rare enough that this never matters.
+* ``missed_heartbeat_callback`` is unusable (pybind std::bad_cast ->
+  terminate), so instead the heartbeat tolerance is set astronomically
+  high: a dead task is never DECLARED dead by the runtime service —
+  death is discovered where it is survivable, in the gloo collective
+  error (~ms) or the bounded health agreement (--health-timeout).
+* Teardown ordering matters, twice over.  The old backend is destroyed
+  BEFORE the rendezvous: destroying it closes this process's gloo
+  sockets, and that close is the only wake-up signal a peer still
+  blocked inside a collective on the dead world ever gets.  (Measured:
+  in a 3-rank ring the dead rank's recv-neighbor errors in
+  milliseconds, but the NEXT rank's recv is posted on the neighbor —
+  a live process — and blocks indefinitely once the neighbor leaves
+  for the rendezvous.  Run the teardown first and that rank unblocks
+  in milliseconds too.)  Destruction is by refcount, so callers must
+  drop everything that pins the old client first — exception
+  tracebacks whose frames hold the old arrays, loader meshes/
+  shardings, and jax's module-level ``_mesh_object_dict`` which caches
+  Mesh objects by device tuple (cli.run_train + _clear_backend_caches
+  handle all of these).  After the new generation's ``manual_init``
+  the caches are cleared AGAIN so nothing rebuilt against the blank
+  interregnum state survives.
+* Coordinator loss is NOT survivable: the distributed KV store lives in
+  the rank-0 service process and dies with it.  Survivors of a
+  coordinator loss get a clean error, not a new world.  (A replicated
+  store is the jaxlib's work, not ours; the README documents this.)
+
+Rendezvous between survivors cannot use the old collectives (they are
+what just failed), so it runs over the shared filesystem — the same
+trust anchor checkpoints already depend on: each survivor writes a
+claim file under ``<elastic-dir>/gen-<g>/``, waits a settle window for
+peers' claims, and the lowest-old-rank claimant elects itself the new
+coordinator, binds a fresh port, and publishes ``world.json`` (member
+list + coordinator address).  Followers poll for it and join with
+``process_id = index of their old rank in the sorted member list`` —
+deterministic, no second agreement round needed.
+
+One residual sharp edge: a parked old SERVICE object still fatals at
+interpreter teardown when the GC finally destroys it (its own poll
+thread sees its own socket close).  ``quiesce_exit`` dodges this: a
+process that has reconfigured flushes stdio and leaves via
+``os._exit`` after its run completes, skipping interpreter teardown.
+The same asymmetry forces an EXIT ORDER across processes: a service
+host's exit closes the service socket, which is an instant fatal for
+every peer whose parked client still polls it — while a departing
+client is only ever noticed through the (disabled) heartbeats.  So
+``quiesce_exit`` is also a barrier: peers drop a done marker in the
+final generation's directory and leave; a service host waits
+(bounded) for all of its peers' markers before it exits.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import logging
+import os
+import socket
+import time
+from typing import List, Optional
+
+from . import faults, flightrec, telemetry
+
+# Leaked prior-generation (client, service) handles — see module doc.
+# Never cleared: clearing is exactly the crash we are avoiding.
+_parked: List[tuple] = []
+
+# Coordinator ports of every generation this process has joined.  The
+# keep-set for _close_stale_collective_sockets: coordination channels
+# (ours AND parked ones, which still heartbeat/poll) must never be cut.
+_coordinator_ports: set = set()
+
+_generation = 0          # 0 = the original world (no reconfigure yet)
+_reconfigured = False
+
+# Exit-order barrier state, set by a successful reconfigure:
+# {"dir": <final generation dir>, "me": <my old rank>,
+#  "peers": [other members' old ranks]}.  See quiesce_exit.
+_barrier: Optional[dict] = None
+
+# How long a claimant waits after the LAST new claim before treating
+# the claim set as settled.  Survivors do NOT discover a failure at the
+# same moment: the dead rank's direct gloo neighbor errors in
+# milliseconds, while a rank whose recv is posted on a still-live
+# neighbor only unblocks when that neighbor tears its backend down on
+# the way to the rendezvous (see the module doc) — so the residual
+# skew is backend-teardown time, seconds at worst.  The settle window
+# must dominate that skew; the exactly-one-loss fast path below (all
+# old_world-1 ranks claimed) keeps the COMMON case prompt regardless.
+SETTLE_S = 20.0
+# How long a follower polls for world.json before giving up (coordinator
+# candidate crashed during rendezvous / coordinator loss).
+WORLD_WAIT_S = 60.0
+# Overall cap on one rendezvous round (claims + settle + join).
+RENDEZVOUS_DEADLINE_S = 120.0
+# How long a coordination-service host waits in quiesce_exit for its
+# peers' done markers before exiting anyway (a peer that crashed after
+# the reconfigure will never write one).
+QUIESCE_BARRIER_S = 60.0
+
+
+class WorldChangedError(RuntimeError):
+    """Control-flow signal, not a failure: the collective world lost a
+    member and this (healthy, --elastic) rank should reconfigure and
+    resume instead of exiting.  Raised by the health boundary, caught
+    by the elastic retraining loop in cli.run_train."""
+
+
+def generation() -> int:
+    """0 before any reconfigure, then 1, 2, ... per shrink."""
+    return _generation
+
+
+def reconfigured() -> bool:
+    """True once this process has torn down and re-joined at least one
+    shrunken world — drivers must then exit via ``quiesce_exit``."""
+    return _reconfigured
+
+
+def _hosts_runtime_service() -> bool:
+    """Does THIS process host any coordination service — parked (it
+    was a past generation's coordinator) or live (it is the current
+    one)?  Such a process's exit closes the service socket under its
+    peers' still-polling clients, which is fatal for them."""
+    if any(svc is not None for _, svc in _parked):
+        return True
+    try:
+        from jax._src import distributed as jdist
+
+        return jdist.global_state.service is not None
+    except Exception:  # broad: exit-path probe — any failure means "no"
+        return False
+
+
+def _exit_barrier() -> None:
+    """Hold a coordination-service host back until its peers are gone.
+
+    Exit order between survivors is asymmetric (module doc): a service
+    host leaving aborts every peer whose parked client still polls
+    that service, while a client leaving is never noticed.  Peers
+    announce their exit with a ``done-<old rank>.json`` marker in the
+    final generation's directory; a host waits — bounded by
+    QUIESCE_BARRIER_S, since a peer that crashed post-reconfigure will
+    never write one — for all of its peers' markers.  A host that
+    never completed a reconfigure (failure path) has no membership to
+    wait on and lingers blind for the same bound.
+    """
+    if _barrier is None:
+        if _hosts_runtime_service():
+            time.sleep(QUIESCE_BARRIER_S)
+        return
+    _write_json(os.path.join(_barrier["dir"],
+                             f"done-{_barrier['me']}.json"),
+                {"pid": os.getpid()})
+    if not _hosts_runtime_service():
+        return
+    deadline = time.monotonic() + QUIESCE_BARRIER_S
+    while time.monotonic() < deadline:
+        if all(os.path.exists(os.path.join(_barrier["dir"],
+                                           f"done-{peer}.json"))
+               for peer in _barrier["peers"]):
+            return
+        time.sleep(0.2)
+    logging.warning(
+        "ELASTIC: exit barrier timed out waiting for peers "
+        f"{_barrier['peers']} — exiting anyway")
+
+
+def quiesce_exit(rc: int) -> None:
+    """Exit without interpreter teardown (see module doc: a parked old
+    coordinator service fatals when the GC destroys it at shutdown).
+    Flushes stdio and the telemetry/flight-recorder sinks first, so a
+    reconfigured run loses nothing observable by exiting this way.
+    A coordination-service host additionally waits for its peers' done
+    markers (see _exit_barrier) so its exit cannot abort them."""
+    try:
+        telemetry.get().close()
+        flightrec.get().close("run_end")
+    except Exception:  # broad: nothing may stop the exit path
+        pass
+    try:
+        _exit_barrier()
+    except Exception:  # broad: ditto — the barrier is best-effort
+        pass
+    try:
+        import sys
+
+        sys.stdout.flush()
+        sys.stderr.flush()
+    except Exception:  # broad: ditto — flushing is best-effort here
+        pass
+    logging.shutdown()
+    os._exit(rc)
+
+
+def is_peer_loss(err: Optional[BaseException]) -> bool:
+    """Classify an exception as "a peer vanished mid-collective".
+
+    The gloo CPU transport surfaces a dead peer as ``ValueError``
+    (jaxlib wraps the absl UNKNOWN status) whose text names the failed
+    collective — 'Gloo AllGather failed', 'Connection closed by peer',
+    'Connection reset'.  TPU runs surface peer loss through the same
+    strings via the distributed runtime, or through the bounded health
+    agreement (HealthTimeoutError) when the peer died between
+    collectives.  PeerFailureError counts too: a peer that REPORTED
+    fatal at the boundary is gone by the time we reconfigure.
+    """
+    if err is None:
+        return False
+    if isinstance(err, (faults.HealthTimeoutError,
+                        faults.PeerFailureError)):
+        return True
+    text = str(err)
+    markers = ("Gloo ", "Connection closed by peer", "Connection reset",
+               "Socket closed", "connection refused",
+               "Broken pipe", "peer is unavailable")
+    return any(m.lower() in text.lower() for m in markers)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def manual_init(coordinator_address: str, num_processes: int,
+                process_id: int) -> None:
+    """Stand up one collective-runtime generation by hand.
+
+    Equivalent to ``jax.distributed.initialize`` except for the three
+    survival-critical knobs it does not expose (see module doc):
+    ``shutdown_on_destruction=False``, a heartbeat tolerance high
+    enough that death is never declared by the runtime service, and
+    coordinator service creation decoupled from process_id 0's client
+    so a reconfigure can re-elect.  Writes jax's distributed global
+    state exactly the way ``initialize`` would, so everything
+    downstream (``xla_bridge.make_cpu_client``'s collectives wiring,
+    ``jax.process_index()``) sees a normal distributed runtime.
+    """
+    from jax._src import distributed as jdist
+    from jax._src.lib import xla_extension as xe
+
+    gs = jdist.global_state
+    if process_id == 0:
+        port = coordinator_address.rsplit(":", 1)[1]
+        gs.service = xe.get_distributed_runtime_service(
+            "[::]:" + port, num_processes,
+            heartbeat_interval=10, max_missing_heartbeats=100000,
+            shutdown_timeout=5)
+    client = xe.get_distributed_runtime_client(
+        coordinator_address, process_id, init_timeout=60,
+        shutdown_timeout=5, heartbeat_interval=10,
+        max_missing_heartbeats=100000,
+        shutdown_on_destruction=False, use_compression=True)
+    client.connect()
+    gs.client = client
+    gs.process_id = process_id
+    gs.num_processes = num_processes
+    gs.coordinator_address = coordinator_address
+    # Every generation's coordinator port joins the keep-set:
+    # _close_stale_collective_sockets must never cut a coordination
+    # channel — parked clients keep polling their (parked) services,
+    # and a cut channel polls an error whose default handler
+    # TERMINATES the process (xla distributed client.h).
+    _coordinator_ports.add(int(coordinator_address.rsplit(":", 1)[1]))
+
+
+def _close_stale_collective_sockets() -> None:
+    """Close the parked generations' gloo pair sockets at the OS level.
+
+    Why so low-level: the PJRT client object is unfreeable from Python
+    on this jaxlib — the Client<->Device wrapper cycle lives in C++
+    refs the cyclic GC cannot see — so its gloo sockets can never be
+    closed by dropping references.  But a peer blocked inside a
+    collective on the dead world unblocks ONLY when the socket its
+    recv is posted on closes (measured: it otherwise stays blocked
+    until this whole process exits).  So the sockets are closed by fd.
+
+    Selection: ESTABLISHED TCP sockets whose ports are NOT a known
+    coordinator port on either end.  Gloo pairs are ephemeral-to-
+    ephemeral, while every coordination-service channel (gRPC) has a
+    coordinator port on one end — cutting one of those would fire the
+    parked client's fatal PollForError handler.  Gloo listeners are in
+    LISTEN state, so they survive too (harmless either way).  The
+    parked runtime never uses these fds again (that is what parking
+    means), so the close is one-way traffic: peers see EOF, we lose
+    nothing.
+    """
+    states = {}
+    for table in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            with open(table) as f:
+                lines = f.readlines()[1:]
+        except OSError:
+            continue
+        for line in lines:
+            parts = line.split()
+            try:
+                lport = int(parts[1].rsplit(":", 1)[1], 16)
+                rport = int(parts[2].rsplit(":", 1)[1], 16)
+                states[parts[9]] = (lport, rport, parts[3])
+            except (IndexError, ValueError):
+                continue
+    closed = 0
+    for fd in os.listdir("/proc/self/fd"):
+        try:
+            target = os.readlink(f"/proc/self/fd/{fd}")
+        except OSError:
+            continue
+        if not target.startswith("socket:["):
+            continue
+        ent = states.get(target[len("socket:["):-1])
+        if ent is None:
+            continue
+        lport, rport, state = ent
+        if state != "01":  # ESTABLISHED only
+            continue
+        if lport in _coordinator_ports or rport in _coordinator_ports:
+            continue
+        try:
+            os.close(int(fd))
+            closed += 1
+        except OSError:
+            continue
+    logging.warning(f"ELASTIC: closed {closed} stale collective "
+                    f"socket(s) of the parked generation(s)")
+
+
+def _park_current_generation() -> None:
+    """Leak the live client+service and blank jax's distributed global
+    state so the next generation can be written in."""
+    from jax._src import distributed as jdist
+
+    gs = jdist.global_state
+    _parked.append((gs.client, gs.service))
+    gs.client = None
+    gs.service = None
+
+
+def _clear_backend_caches() -> None:
+    """Invalidate everything that memoized the OLD world's shape.
+
+    ``_clear_backends`` drops jax's reference to the backend built
+    against the old global state; ``process_count``/``local_devices``
+    are module-level lru_caches that ``_clear_backends`` does NOT clear
+    and would otherwise keep answering with the old world size.
+    ``_mesh_object_dict`` is jax's Mesh-object cache, keyed by device
+    tuple — left alone it pins the old devices (and through them the
+    old client + its gloo sockets) forever, defeating the
+    teardown-before-rendezvous unblocking in ``reconfigure``.
+
+    The pin hunt below was empirical (referrer-graph walk on this
+    jax/jaxlib): ``_backends`` must be cleared IN PLACE because the
+    ``jax.lib.xla_bridge`` compat shim aliases the dict OBJECT — the
+    rebind inside ``_clear_backends`` strands the old client in the
+    shim's copy; and plain ``functools.lru_cache``s on jax modules
+    (e.g. ``jax._src.api._check_sharding``) hold Devices in their KEY
+    tuples and are invisible to ``jax.clear_caches()``, which only
+    knows jax's own cache registries.
+    """
+    import functools
+
+    import jax
+    from jax._src import mesh as jax_mesh
+    from jax._src import xla_bridge
+
+    xla_bridge._backends.clear()
+    xla_bridge._clear_backends()
+    for cached in ("process_count", "local_devices", "device_count",
+                   "process_indices"):
+        fn = getattr(xla_bridge, cached, None)
+        if fn is not None and hasattr(fn, "cache_clear"):
+            fn.cache_clear()
+    getattr(jax_mesh, "_mesh_object_dict", {}).clear()
+    jax.clear_caches()
+    for obj in gc.get_objects():
+        if isinstance(obj, functools._lru_cache_wrapper):
+            mod = getattr(getattr(obj, "__wrapped__", None),
+                          "__module__", "") or ""
+            if mod.startswith("jax"):
+                try:
+                    obj.cache_clear()
+                except Exception:  # a dying cache must not stop teardown
+                    pass
+
+
+# -- filesystem rendezvous --------------------------------------------
+
+
+def default_elastic_dir(rsl_path: str) -> str:
+    """``--elastic-dir`` default: inside the run directory, which the
+    checkpoint machinery already requires to be shared across hosts."""
+    return os.path.join(rsl_path, "elastic")
+
+
+def _gen_dir(elastic_dir: str, gen: int) -> str:
+    return os.path.join(elastic_dir, f"gen-{gen}")
+
+
+def _write_json(path: str, doc: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _claimed_ranks(gen_dir: str) -> List[int]:
+    try:
+        names = os.listdir(gen_dir)
+    except OSError:
+        return []
+    ranks = []
+    for name in names:
+        if name.startswith("rank-") and name.endswith(".json"):
+            try:
+                ranks.append(int(name[len("rank-"):-len(".json")]))
+            except ValueError:
+                continue
+    return sorted(ranks)
+
+
+def _rendezvous(elastic_dir: str, gen: int, old_rank: int,
+                old_world: int) -> dict:
+    """One claim/elect/publish round.  Returns the world.json doc:
+    ``{"generation": g, "members": [old ranks...], "coordinator": addr}``.
+
+    Every survivor: write my claim, wait for the claim set to settle
+    (no new claim for SETTLE_S).  Lowest claimed old rank: self-elect,
+    bind a free port, publish world.json.  Everyone else: poll for
+    world.json, check membership.  A straggler that claims after the
+    settle window missed the generation — it finds itself absent from
+    ``members`` and fails loudly rather than wedging the new world.
+    """
+    gen_dir = _gen_dir(elastic_dir, gen)
+    os.makedirs(gen_dir, exist_ok=True)
+    _write_json(os.path.join(gen_dir, f"rank-{old_rank}.json"),
+                {"old_rank": old_rank, "pid": os.getpid()})
+    world_path = os.path.join(gen_dir, "world.json")
+
+    deadline = time.monotonic() + RENDEZVOUS_DEADLINE_S
+    members = [old_rank]
+    last_change = time.monotonic()
+    while time.monotonic() < deadline:
+        if os.path.exists(world_path):
+            break  # someone already elected and published
+        now_claimed = _claimed_ranks(gen_dir)
+        if now_claimed != members:
+            members = now_claimed
+            last_change = time.monotonic()
+        # Fast path for the common case, exactly one rank lost: once
+        # every other old rank has claimed, there is no one left to
+        # wait for — publish immediately instead of sitting out the
+        # settle window (which exists to cover multi-loss, where the
+        # claim set can't tell us when it is complete).
+        complete = len(members) == old_world - 1
+        settled = complete \
+            or (time.monotonic() - last_change) >= SETTLE_S
+        # The settle window can only end the wait for the would-be
+        # coordinator; followers keep polling for world.json so a
+        # slow-to-settle coordinator doesn't strand them.
+        if settled and members and members[0] == old_rank:
+            if len(members) >= old_world:
+                raise RuntimeError(
+                    "elastic rendezvous: every rank of the old world "
+                    f"claimed generation {gen} ({members}) — nothing "
+                    "actually died; refusing to reconfigure")
+            host = os.environ.get("JAX_ELASTIC_HOST", "localhost")
+            address = f"{host}:{_free_port()}"
+            doc = {"generation": gen, "members": members,
+                   "coordinator": address}
+            _write_json(world_path, doc)
+            return doc
+        time.sleep(0.2)
+
+    waited = time.monotonic()
+    while time.monotonic() - waited < WORLD_WAIT_S:
+        if os.path.exists(world_path):
+            try:
+                with open(world_path) as f:
+                    doc = json.load(f)
+                if doc.get("generation") == gen:
+                    if old_rank not in doc.get("members", []):
+                        raise RuntimeError(
+                            f"elastic rendezvous: rank {old_rank} "
+                            f"missed generation {gen} (members "
+                            f"{doc.get('members')}) — claimed after "
+                            "the settle window; exiting rather than "
+                            "wedging the new world")
+                    return doc
+            except (OSError, ValueError):
+                pass  # mid-replace read; retry
+        time.sleep(0.2)
+    raise RuntimeError(
+        f"elastic rendezvous: no world.json for generation {gen} "
+        f"within {WORLD_WAIT_S}s — coordinator candidate lost?")
+
+
+def reconfigure(elastic_dir: str, old_rank: int, old_world: int) -> dict:
+    """Tear down the failed generation and join the shrunken one.
+
+    Returns ``{"generation", "members", "coordinator", "new_rank",
+    "new_world"}``.  The collective-runtime re-init (the transient-
+    failure-prone part: a follower can race the new coordinator's
+    service coming up) runs under the process retry policy at fault
+    site ``elastic.reinit``.
+    """
+    global _generation, _reconfigured, _barrier
+    gen = _generation + 1
+    logging.warning(
+        f"ELASTIC: rank {old_rank} reconfiguring from world size "
+        f"{old_world} (generation {gen})")
+    # Tear the failed generation down BEFORE the rendezvous: closing
+    # our gloo sockets is the wake-up signal for any peer still
+    # blocked inside a collective on the dead world.  Done after the
+    # rendezvous instead, that peer stays blocked through our whole
+    # settle window and misses the generation.  The gc.collect frees
+    # the old arrays' buffers; the socket close is separate because
+    # the client object itself is unfreeable (see
+    # _close_stale_collective_sockets).
+    _park_current_generation()
+    _barrier = None  # a failed round must not reuse stale membership
+    try:
+        _clear_backend_caches()
+        gc.collect()
+        _close_stale_collective_sockets()
+        doc = _rendezvous(elastic_dir, gen, old_rank, old_world)
+        members = sorted(doc["members"])
+        new_rank = members.index(old_rank)
+        new_world = len(members)
+
+        def _reinit():
+            faults.fire("elastic.reinit")
+            manual_init(doc["coordinator"], new_world, new_rank)
+
+        # RuntimeError covers a failed/timed-out connect to a
+        # coordinator service that isn't up yet — same classification
+        # as runtime.init.
+        faults.retry(_reinit, "elastic.reinit",
+                     transient=(OSError, TimeoutError, RuntimeError))
+        # Again, post-reinit: drop anything rebuilt against the blank
+        # interregnum global state while the rendezvous was running.
+        _clear_backend_caches()
+    except BaseException:
+        # Past the park there is no way back: this process can never
+        # survive interpreter teardown again (the GC destroying a
+        # parked service is the fatal this module exists to dodge),
+        # so a failed reconfigure logs the full error and leaves
+        # through quiesce_exit instead of raising.
+        logging.error(
+            f"ELASTIC: rank {old_rank} failed to join generation "
+            f"{gen}; exiting", exc_info=True)
+        quiesce_exit(1)
+
+    _barrier = {"dir": _gen_dir(elastic_dir, gen), "me": old_rank,
+                "peers": [m for m in members if m != old_rank]}
+    _generation = gen
+    _reconfigured = True
+    logging.warning(
+        f"ELASTIC: generation {gen} up — old rank {old_rank} is now "
+        f"rank {new_rank} of {new_world} "
+        f"(coordinator {doc['coordinator']})")
+    return {"generation": gen, "members": members,
+            "coordinator": doc["coordinator"], "new_rank": new_rank,
+            "new_world": new_world}
+
+
+def _reset_for_tests() -> None:
+    """Test hook: forget generations WITHOUT touching parked handles
+    (parked objects must stay leaked even in tests)."""
+    global _generation, _reconfigured, _barrier
+    _generation = 0
+    _reconfigured = False
+    _barrier = None

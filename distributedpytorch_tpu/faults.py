@@ -13,6 +13,7 @@ named sites threaded through the runtime.  Sites currently wired:
                    final path — the only site where kind=torn applies)
   ckpt.restore     checkpoint.py read/restore
   runtime.init     runtime.py jax.distributed.initialize
+  elastic.reinit   elastic.py shrunken-world re-initialization
   telemetry.write  telemetry.py JSONL writer
 
 Plan forms (``--fault-plan``):
@@ -37,7 +38,11 @@ meaningful at ckpt.finalize), ``stall`` (sleep ``stall_s`` seconds at
 the site and carry on — a deterministic straggler/slow-I/O injection;
 this is how the flight recorder's anomaly trigger path is proven:
 one stalled step must produce exactly one profiler capture, see
-scripts/anomaly_gate.py).
+scripts/anomaly_gate.py), ``rank_loss`` (``os._exit(113)`` — the
+process vanishes mid-collective with no cleanup, no SIGTERM handler,
+no flushed buffers: the shape of a preempted/oom-killed host its
+peers must detect and survive; this is how the elastic reconfigure
+path is proven, see scripts/chaos_gate.py --stage elastic).
 
 Every firing emits a ``fault_injected`` telemetry event and a flight-
 recorder event (flightrec.py), so chaos runs are auditable from the
@@ -75,10 +80,16 @@ from . import flightrec, telemetry
 
 T = TypeVar("T")
 
-KINDS = ("ioerror", "fatal", "preempt", "torn", "stall")
+KINDS = ("ioerror", "fatal", "preempt", "torn", "stall", "rank_loss")
 
 SITES = ("data.read", "data.host_batch", "ckpt.save", "ckpt.finalize",
-         "ckpt.restore", "runtime.init", "telemetry.write")
+         "ckpt.restore", "runtime.init", "elastic.reinit",
+         "telemetry.write")
+
+# Exit code of a rank killed by kind=rank_loss: distinguishable in the
+# harness from a crash (1), a fatal-agreement exit (CHILD_EXIT) and a
+# SIGTERM death, so the chaos gate can assert the RIGHT rank vanished.
+RANK_LOSS_EXIT = 113
 
 
 class InjectedIOError(OSError):
@@ -97,6 +108,16 @@ class PeerFailureError(RuntimeError):
     reports that some other rank hit a fatal error: every rank leaves
     the training loop at the same boundary instead of hanging in the
     dead rank's next collective."""
+
+
+class HealthTimeoutError(RuntimeError):
+    """The bounded health agreement (--health-timeout) did not complete
+    in time: a peer is gone (or wedged) and never reached the boundary
+    collective.  The local rank converts the hang it WOULD have suffered
+    into this verdict — under --elastic the trigger for reconfiguring
+    into the surviving world, otherwise a loud exit instead of a
+    deadlock.  Lives here (not elastic.py) so runtime.py can raise it
+    without an import cycle."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -214,6 +235,16 @@ class FaultPlan:
         if spec.kind == "preempt":
             os.kill(os.getpid(), signal.SIGTERM)
             return
+        if spec.kind == "rank_loss":
+            # Vanish NOW: no atexit, no SIGTERM handler, no cleanup —
+            # peers find out when their next collective to us fails.
+            # Only the fault_injected line above is flushed first so
+            # the injection itself stays auditable from the JSONL.
+            try:
+                tel.flush()
+            except Exception:  # broad: the point is to die regardless
+                pass
+            os._exit(RANK_LOSS_EXIT)
         if spec.kind == "torn":
             _tear(path)
 

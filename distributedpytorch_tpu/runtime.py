@@ -67,7 +67,8 @@ def _multihost_env() -> bool:
 
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
-                           process_id: Optional[int] = None) -> None:
+                           process_id: Optional[int] = None,
+                           elastic: bool = False) -> None:
     """Multi-host rendezvous.  No-op on a single host.
 
     TPU equivalent of ref classif.py:86-87 (init_process_group) + the env-var
@@ -76,6 +77,16 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     args are an escape hatch for manual clusters (the moral equivalent of
     the reference's DDTNodes table, but optional) — and the path the
     multi-process CPU test drives.
+
+    ``elastic=True`` (--elastic runs) stands the runtime up via
+    ``elastic.manual_init`` instead of ``jax.distributed.initialize``:
+    the stock client terminates the PROCESS from a C++ callback when
+    the coordination service declares a peer dead (heartbeat timeout),
+    which would kill the survivors the elastic path exists to save.
+    The manual recipe disables that declaration so peer death is only
+    ever discovered where it is survivable (collective error / bounded
+    health agreement); it requires an explicit coordinator/world (args
+    or env), matching how elastic jobs are launched.
     """
     global _initialized
     if _initialized:
@@ -109,10 +120,18 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
             # the process retry policy.  RuntimeError is how
             # jax.distributed surfaces a failed/timed-out rendezvous.
             faults.fire("runtime.init")
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id)
+            if elastic and coordinator_address is not None \
+                    and num_processes is not None \
+                    and process_id is not None:
+                from . import elastic as elastic_mod
+
+                elastic_mod.manual_init(coordinator_address,
+                                        num_processes, process_id)
+            else:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id)
 
         faults.retry(_init, "runtime.init",
                      transient=(OSError, TimeoutError, RuntimeError))
@@ -211,7 +230,8 @@ def any_process(flag: bool) -> bool:
     return bool(np.any(flags))
 
 
-def agree_health(failed: bool, shutdown: bool) -> tuple:
+def agree_health(failed: bool, shutdown: bool,
+                 timeout_s: Optional[float] = None) -> tuple:
     """(any_failed, any_shutdown) across every process — ONE allgather.
 
     The failure-agreement extension of ``any_process``: a rank that hit
@@ -223,6 +243,18 @@ def agree_health(failed: bool, shutdown: bool) -> tuple:
     rank and raises ``faults.PeerFailureError`` on the healthy ones —
     every rank exits cleanly, same boundary, nonzero.
 
+    ``timeout_s`` (--health-timeout) bounds the agreement itself: the
+    allgather only completes when EVERY peer reaches the boundary, so a
+    rank that died between boundaries (SIGKILL, OOM, preemption without
+    grace) would otherwise hang the survivors right here — the one
+    collective that was supposed to detect failure.  With a timeout the
+    allgather runs on a daemon thread; if it hasn't completed in time
+    the local verdict is ``faults.HealthTimeoutError`` and the caller
+    decides (reconfigure under --elastic, loud exit otherwise).  The
+    abandoned thread is left to the runtime teardown — Python offers no
+    safe preemption, and the gloo transport either errors it out
+    promptly or the process is about to exit/reinit anyway.
+
     Folding both flags into one message keeps the collective schedule
     identical to the old single-flag health check (no extra rendezvous
     per boundary).  Single-process: no communication.
@@ -231,8 +263,32 @@ def agree_health(failed: bool, shutdown: bool) -> tuple:
         return bool(failed), bool(shutdown)
     from jax.experimental import multihost_utils
 
-    flags = multihost_utils.process_allgather(
-        np.array([failed, shutdown], dtype=bool))
+    def _gather():
+        return multihost_utils.process_allgather(
+            np.array([failed, shutdown], dtype=bool))
+
+    if timeout_s is None or timeout_s <= 0:
+        flags = _gather()
+    else:
+        box: dict = {}
+
+        def _run():
+            try:
+                box["flags"] = _gather()
+            except BaseException as e:  # surfaced on the caller thread
+                box["error"] = e
+
+        t = threading.Thread(target=_run, daemon=True,
+                             name="agree_health")
+        t.start()
+        t.join(timeout_s)
+        if t.is_alive():
+            raise faults.HealthTimeoutError(
+                f"health agreement did not complete within {timeout_s}s"
+                " — a peer died or wedged before reaching the boundary")
+        if "error" in box:
+            raise box["error"]
+        flags = box["flags"]
     return bool(np.any(flags[..., 0])), bool(np.any(flags[..., 1]))
 
 

@@ -22,6 +22,11 @@ straggly boundary (a rank that lingered in the allgather) harmless.
 Runs without shared boundaries (single rank, --no-health-checks) fall
 back to wall-clock alignment via each rank's median ``ts - mono`` delta —
 correct up to host clock skew, which the skew report then quantifies.
+The fallback is per rank ("mixed" mode): one boundary-less stream — a
+rank that died mid-epoch before its first boundary, the elastic
+rank-loss shape — degrades only itself, and an ``elastic/reconfigure``
+boundary in the events is surfaced as a survivors/departed warning
+rather than a crash or silent truncation.
 
 Skew report.  At every shared boundary the ranks' *wall* stamps should
 agree too; their spread (max - min) is the measured cross-rank wall-clock
@@ -88,26 +93,58 @@ def _wall_delta(events: List[Dict[str, Any]], rank: int) -> Optional[float]:
 def _alignment(events: List[Dict[str, Any]], ranks: List[int]
                ) -> Tuple[Dict[int, float], str, List[str]]:
     """Per-rank offset to add to that rank's mono stamps so all ranks
-    share one time axis.  Returns (offsets, method, warnings)."""
+    share one time axis.  Returns (offsets, method, warnings).
+
+    Alignment is PER RANK, not all-or-nothing: a single rank with no
+    shared boundary (one that died before its first health_boundary —
+    the elastic rank-loss shape — or a freshly joined stream) falls
+    back to its own wall clock with a warning naming it, while every
+    other rank keeps the precise boundary alignment.  Method is
+    "health_boundary" when every rank aligned on boundaries,
+    "wall_clock" when none could, "mixed" otherwise.  In mixed mode
+    every offset targets the WALL axis (boundary offsets are shifted by
+    the base rank's own ts-mono delta) so the two kinds of offset land
+    on one comparable axis.
+    """
     warnings: List[str] = []
     bounds = _boundaries(events)
-    offsets: Dict[int, float] = {}
     base = min(ranks)
+    boundary_offsets: Dict[int, float] = {}
+    fallback: List[int] = []
     if base in bounds and len(ranks) > 1:
-        offsets[base] = 0.0
-        aligned = True
+        boundary_offsets[base] = 0.0
         for r in ranks:
             if r == base:
                 continue
             shared = sorted(set(bounds.get(r, {})) & set(bounds[base]))
-            if not shared:
-                aligned = False
-                break
-            offsets[r] = statistics.median(
-                bounds[base][e]["mono"] - bounds[r][e]["mono"]
-                for e in shared)
-        if aligned:
-            return offsets, "health_boundary", warnings
+            if shared:
+                boundary_offsets[r] = statistics.median(
+                    bounds[base][e]["mono"] - bounds[r][e]["mono"]
+                    for e in shared)
+            else:
+                fallback.append(r)
+        if not fallback:
+            return boundary_offsets, "health_boundary", warnings
+        if len(boundary_offsets) > 1:
+            # Mixed: most ranks align precisely; the boundary-less ones
+            # (truncated by a mid-epoch death, typically) ride their own
+            # wall clock — comparable up to host clock skew.
+            for r in fallback:
+                warnings.append(
+                    f"clock alignment: rank {r} shares no "
+                    f"health_boundary with rank {base} (stream "
+                    "truncated before its first boundary?); aligning "
+                    "it by wall clock only")
+            base_delta = _wall_delta(events, base)
+            if base_delta is not None:
+                offsets = {r: off + base_delta
+                           for r, off in boundary_offsets.items()}
+                for r in fallback:
+                    d = _wall_delta(events, r)
+                    offsets[r] = d if d is not None else base_delta
+                return offsets, "mixed", warnings
+            # base has no usable ts/mono pairs at all — degenerate;
+            # drop to the uniform wall-clock fallback below.
         warnings.append("clock alignment: not every rank shares a "
                         "health_boundary with rank "
                         f"{base}; falling back to wall clocks")
@@ -196,6 +233,26 @@ def build_timeline(rsl_path: str) -> Dict[str, Any]:
             warnings.append(f"no flight record for rank {r} "
                             f"(flightrec-rank{r}.json missing/unreadable); "
                             "timeline shows telemetry spans only")
+    # Elastic reconfigure boundary (elastic.py): every survivor emits an
+    # elastic/reconfigure event; a rank present in the run but absent
+    # from that set is the departed one — its stream simply truncates at
+    # the failure.  Named here so a shrunken-world trace reads as a
+    # reconfigure, not as data loss.
+    reconf = [ev for ev in events
+              if ev.get("kind") == "event"
+              and ev.get("name") == "elastic/reconfigure"
+              and isinstance(ev.get("rank"), int)]
+    if reconf:
+        survivors = sorted({int(ev["rank"]) for ev in reconf})
+        departed = sorted(set(ranks) - set(survivors))
+        gens = sorted({_attrs(ev).get("generation") for ev in reconf
+                       if _attrs(ev).get("generation") is not None})
+        warnings.append(
+            f"elastic reconfigure (generation(s) {gens}): survivors "
+            f"{survivors} continued in a smaller world"
+            + (f"; rank(s) {departed} departed — their streams "
+               "truncate at the failure, which is expected, not data "
+               "loss" if departed else ""))
 
     def aligned(rank: int, mono: float) -> float:
         return mono + offsets.get(rank, 0.0)

@@ -18,9 +18,20 @@ Four stages, all CPU and bounded:
      fatal fault injected on rank 0 only: BOTH ranks must exit nonzero
      within the deadline (no hang), and both telemetry JSONLs must
      carry the ``peer_failure`` event.
+  E. elastic (``--stage elastic``, its own gate.sh leg) — three real
+     processes with --elastic; a ``rank_loss`` fault kills rank 2
+     mid-epoch-1 (``os._exit``, no cleanup).  Ranks 0/1 must
+     reconfigure into a 2-rank world, resume from the epoch-0
+     snapshot, finish, and exit 0 — and their final checkpoint must
+     equal (allclose) an uninterrupted 2-rank reference run resumed
+     from a copy of the same epoch-0 snapshot.  Asserted from the
+     shared run dir: ``elastic/reconfigure`` in both survivors'
+     JSONLs, flight dumps carrying reason ``reconfigure``, rank 2
+     exiting with the rank-loss status.
 
-Run as ``env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py``.
-The script re-execs itself with ``--child`` for stage D's ranks.
+Run as ``env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py``
+(stages A-D) or with ``--stage elastic`` (stage E only).  The script
+re-execs itself with ``--child`` for the multi-process stages' ranks.
 """
 
 import argparse
@@ -82,7 +93,7 @@ def _params(result) -> list:
             jax.tree_util.tree_leaves(result["state"].params)]
 
 
-def main() -> int:
+def main(stage: str = "core") -> int:
     from __graft_entry__ import _force_cpu_devices
 
     _force_cpu_devices(1)
@@ -95,6 +106,16 @@ def main() -> int:
 
     problems = []
     work = tempfile.mkdtemp(prefix="chaos_gate_")
+
+    if stage == "elastic":
+        problems = _stage_elastic(work)
+        for p in problems:
+            print(f"PROBLEM: {p}", file=sys.stderr)
+        if problems:
+            return 1
+        print("chaos gate OK: rank loss survived, world shrunk, resumed "
+              "run matches the uninterrupted reference")
+        return 0
 
     # -- stage A: fault-free reference --------------------------------
     ref = run_train(_base_cfg(os.path.join(work, "ref")))
@@ -233,6 +254,177 @@ def _stage_fatal_agreement(work: str, plan_dir: str) -> list:
     return problems
 
 
+def _spawn_world(work: str, tag: str, nprocs: int, rsls: list,
+                 plan: str = None, elastic: bool = False,
+                 epochs: int = 2, ckpt_file: str = None,
+                 stream: bool = False) -> list:
+    """Spawn ``nprocs`` ranks of this script as real processes over a
+    gloo rendezvous; return [(rank, rc-or-None, logpath)] once all exit
+    or the shared deadline lapses (hung ranks are killed, rc None)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        coord = f"localhost:{s.getsockname()[1]}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs, logs = [], []
+    for pid in range(nprocs):
+        log = os.path.join(work, f"{tag}_rank{pid}.log")
+        logs.append(log)
+        argv = [sys.executable, os.path.abspath(__file__), "--child",
+                "--coord", coord, "--pid", str(pid),
+                "--nprocs", str(nprocs), "--epochs", str(epochs),
+                "--rsl", rsls[pid]]
+        if plan:
+            argv += ["--plan", plan]
+        if elastic:
+            argv += ["--elastic"]
+        if ckpt_file:
+            argv += ["--ckpt", ckpt_file]
+        if stream:
+            argv += ["--stream"]
+        # A log FILE, never a pipe (see _stage_fatal_agreement).
+        out = open(log, "ab")
+        procs.append(subprocess.Popen(argv, cwd=REPO, env=env,
+                                      stdout=out, stderr=out))
+    deadline = time.monotonic() + CHILD_DEADLINE_S
+    results = []
+    for pid, p in enumerate(procs):
+        try:
+            rc = p.wait(timeout=max(1.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            rc = None
+        results.append((pid, rc, logs[pid]))
+    return results
+
+
+def _ckpt_state_leaves(path: str) -> list:
+    """Numeric leaves of a msgpack checkpoint's model params, in
+    deterministic tree order — world-size independent (files are written
+    from the gathered/replicated state)."""
+    from flax import serialization
+    import jax
+    import numpy as np
+
+    with open(path, "rb") as f:
+        payload = serialization.msgpack_restore(f.read())
+    return [np.asarray(leaf) for leaf in
+            jax.tree_util.tree_leaves(payload["state"]["params"])]
+
+
+def _stage_elastic(work: str) -> list:
+    """Stage E driver: 3 gloo ranks under --elastic; a rank_loss fault
+    vanishes rank 2 mid-epoch-1 (after the epoch-0 snapshot lands).
+    Ranks 0/1 must reconfigure to a 2-rank world, resume from that
+    snapshot, finish all epochs and exit 0; rank 2 must exit with the
+    rank-loss status.  The survivors' final checkpoint must equal an
+    uninterrupted 2-rank reference resumed from a copy of the same
+    epoch-0 snapshot."""
+    import shutil
+
+    import numpy as np
+
+    from distributedpytorch_tpu import checkpoint as ckpt
+    from distributedpytorch_tpu import flightrec
+    from distributedpytorch_tpu.faults import RANK_LOSS_EXIT
+
+    problems = []
+    rsl_a = os.path.join(work, "elastic")
+    os.makedirs(rsl_a, exist_ok=True)
+    # Hit math (world 3, batch 4, --debug => 200-sample train AND valid
+    # splits, streamed so data.host_batch is live): ceil(200/3/4) = 17
+    # steps/epoch per split, so epoch 0 is host-batch hits 1..34
+    # (train+valid), the epoch-0 checkpoint lands at that boundary, and
+    # epoch 1's train pass is hits 35..51.  after_n=40 fires on hit 41
+    # — train step 7 of epoch 1.
+    plan_path = os.path.join(work, "rank_loss_plan.json")
+    with open(plan_path, "w") as f:
+        json.dump({"faults": [{"site": "data.host_batch",
+                               "kind": "rank_loss", "after_n": 40,
+                               "count": 1, "rank": 2}]}, f)
+    results = _spawn_world(work, "elastic", nprocs=3, rsls=[rsl_a] * 3,
+                           plan=plan_path, elastic=True, epochs=EPOCHS,
+                           stream=True)
+    for pid, rc, log in results:
+        want = RANK_LOSS_EXIT if pid == 2 else 0
+        label = ("rank-loss exit" if pid == 2
+                 else "survived + reconfigured")
+        if rc is None:
+            problems.append(f"elastic rank {pid} HUNG past "
+                            f"{CHILD_DEADLINE_S:.0f}s\n{_tail(log)}")
+        elif rc != want:
+            problems.append(f"elastic rank {pid} exited rc={rc}, "
+                            f"expected {want} ({label})\n{_tail(log)}")
+    if problems:
+        return problems
+
+    # Survivors' trail: reconfigure event in BOTH telemetry JSONLs
+    # (original rank files — telemetry keeps the pre-shrink rank id) and
+    # a flight dump whose reasons include the reconfigure.
+    for pid in (0, 1):
+        try:
+            evs = _named(_events(rsl_a, rank=pid), "elastic/reconfigure")
+        except OSError:
+            evs = []
+        if not evs:
+            problems.append(f"survivor rank {pid} has no "
+                            f"elastic/reconfigure telemetry event")
+        elif evs[0]["attrs"].get("new_world") != 2:
+            problems.append(f"survivor rank {pid} reconfigured to world "
+                            f"{evs[0]['attrs'].get('new_world')}, not 2")
+    dumps = flightrec.load_dumps(rsl_a)
+    for pid in (0, 1):
+        reasons = dumps.get(pid, {}).get("reasons", [])
+        if "reconfigure" not in reasons:
+            problems.append(f"survivor rank {pid} flight dump reasons "
+                            f"{reasons} lack 'reconfigure'")
+
+    # Reference: a FRESH 2-rank world resumed from a copy of the very
+    # snapshot the survivors fell back to.  No lineage ledger is copied
+    # on purpose: pre-lineage files verify as None (loadable), and the
+    # reference run then builds its own ledger in rsl_b.
+    epoch0 = ckpt.checkpoint_path(rsl_a, "synthetic", "mlp", 0)
+    if not os.path.exists(epoch0):
+        return problems + [f"epoch-0 snapshot {epoch0} missing — the "
+                           f"fault fired before the first checkpoint"]
+    rsl_b = os.path.join(work, "elastic_ref")
+    os.makedirs(rsl_b, exist_ok=True)
+    ref0 = ckpt.checkpoint_path(rsl_b, "synthetic", "mlp", 0)
+    shutil.copy2(epoch0, ref0)
+    results = _spawn_world(work, "elastic_ref", nprocs=2,
+                           rsls=[rsl_b] * 2, epochs=EPOCHS,
+                           ckpt_file=ref0, stream=True)
+    for pid, rc, log in results:
+        if rc != 0:
+            problems.append(f"reference rank {pid} exited rc={rc}, "
+                            f"expected 0\n{_tail(log)}")
+    if problems:
+        return problems
+
+    final_a = ckpt.checkpoint_path(rsl_a, "synthetic", "mlp", EPOCHS - 1)
+    final_b = ckpt.checkpoint_path(rsl_b, "synthetic", "mlp", EPOCHS - 1)
+    for path, who in ((final_a, "survivors"), (final_b, "reference")):
+        if not os.path.exists(path):
+            problems.append(f"{who} wrote no final checkpoint {path}")
+    if problems:
+        return problems
+    pa, pb = _ckpt_state_leaves(final_a), _ckpt_state_leaves(final_b)
+    if len(pa) != len(pb) or not all(
+            np.allclose(a, b, rtol=1e-5, atol=1e-6)
+            for a, b in zip(pa, pb)):
+        problems.append("survivors' final params differ from the "
+                        "uninterrupted 2-rank reference — the shrunken "
+                        "world did not recover bit-compatibly")
+    if not problems:
+        print("chaos gate E: rank 2 vanished mid-epoch, ranks 0/1 "
+              "reconfigured to world 2, resumed from the epoch-0 "
+              "snapshot and matched the reference")
+    return problems
+
+
 def _tail(path: str, n: int = 2500) -> str:
     try:
         return open(path).read()[-n:]
@@ -241,37 +433,57 @@ def _tail(path: str, n: int = 2500) -> str:
 
 
 def child_main(a) -> int:
-    """One stage-D rank: join the gloo rendezvous, train under the fatal
-    plan, and exit CHILD_EXIT on the agreed failure path."""
+    """One multi-process rank (stages D and E): join the gloo
+    rendezvous, train under the given plan/flags, exit CHILD_EXIT on
+    the agreed failure path — and, if the world was reconfigured, leave
+    through ``elastic.quiesce_exit`` (the parked pre-shrink runtime
+    must never see interpreter teardown)."""
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_cpu_enable_async_dispatch", False)
 
-    from distributedpytorch_tpu import faults, runtime
+    from distributedpytorch_tpu import elastic, faults, runtime
     from distributedpytorch_tpu.cli import run_train
 
     runtime.initialize_distributed(coordinator_address=a.coord,
-                                   num_processes=2, process_id=a.pid)
-    cfg = _base_cfg(a.rsl).replace(fault_plan=a.plan, nb_epochs=2,
-                                   batch_size=4)
+                                   num_processes=a.nprocs,
+                                   process_id=a.pid, elastic=a.elastic)
+    cfg = _base_cfg(a.rsl).replace(
+        fault_plan=a.plan, nb_epochs=a.epochs, batch_size=4,
+        checkpoint_file=a.ckpt, elastic=a.elastic,
+        health_timeout=20.0 if a.elastic else 0.0,
+        # stage E streams: data.host_batch (the rank_loss site) is only
+        # live on the streamed path, and reshard-on-shrink is the
+        # ShardedLoader contract under proof here
+        data_mode="stream" if a.stream else "auto")
     try:
         run_train(cfg)
     except (faults.FatalFaultError, faults.PeerFailureError) as e:
         print(f"rank {a.pid}: agreed fatal exit: {e}", file=sys.stderr)
-        return CHILD_EXIT
-    print(f"rank {a.pid}: run finished WITHOUT the fatal fault firing",
-          file=sys.stderr)
-    return 0
+        rc = CHILD_EXIT
+    else:
+        rc = 0
+        print(f"rank {a.pid}: run finished, rc=0", file=sys.stderr)
+    if elastic.reconfigured():
+        elastic.quiesce_exit(rc)  # never returns
+    return rc
 
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
+    ap.add_argument("--stage", choices=("core", "elastic"),
+                    default="core")
     ap.add_argument("--child", action="store_true")
     ap.add_argument("--coord")
     ap.add_argument("--pid", type=int)
+    ap.add_argument("--nprocs", type=int, default=2)
+    ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--plan")
     ap.add_argument("--rsl")
+    ap.add_argument("--ckpt")
+    ap.add_argument("--elastic", action="store_true")
+    ap.add_argument("--stream", action="store_true")
     args = ap.parse_args()
-    sys.exit(child_main(args) if args.child else main())
+    sys.exit(child_main(args) if args.child else main(args.stage))

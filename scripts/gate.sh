@@ -28,7 +28,12 @@
 #      false-positive check; recorder overhead budget; 2-rank timeline
 #      merge — see scripts/anomaly_gate.py and README "Flight recorder,
 #      anomaly profiling & timeline"
-#   7. the driver's own gate: __graft_entry__.dryrun_multichip(8)
+#   7. elastic gate: a 3-process gloo world with --elastic loses a rank
+#      mid-epoch; survivors must shrink to 2, resume from the newest
+#      snapshot, and finish with params allclose-identical to an
+#      uninterrupted 2-rank reference — see scripts/chaos_gate.py
+#      --stage elastic and README "Elastic training"
+#   8. the driver's own gate: __graft_entry__.dryrun_multichip(8)
 #      (clean env, exactly as the driver runs it)
 #
 # Tier map:
@@ -75,6 +80,9 @@ env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py
 
 echo "== gate: anomaly (flight recorder / capture / timeline) =="
 env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/anomaly_gate.py
+
+echo "== gate: elastic (rank loss / shrink / resume parity) =="
+env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py --stage elastic
 
 echo "== gate: dryrun_multichip(8) =="
 env -u XLA_FLAGS -u JAX_PLATFORMS python -c \

@@ -735,6 +735,79 @@ def test_parse_error_is_finding_not_crash(tmp_path):
     assert [f.rule for f in findings] == ["parse-error"]
 
 
+# -- rule 12: collective-in-cleanup -----------------------------------
+
+_CLEANUP_BAD_EXCEPT = """
+    from distributedpytorch_tpu import runtime
+
+    def boundary(err):
+        try:
+            step()
+        except Exception:
+            runtime.agree_health(True, False)
+            raise
+"""
+
+_CLEANUP_BAD_FINALLY = """
+    import jax
+
+    def teardown(x):
+        try:
+            return step(x)
+        finally:
+            jax.experimental.multihost_utils.sync_global_devices("bye")
+"""
+
+_CLEANUP_RATIONALE = """
+    from distributedpytorch_tpu import runtime
+
+    def boundary(err):
+        try:
+            step()
+        except Exception:
+            # every rank takes this path: the epoch loop funnels ALL
+            # exits (success included) through this agreement point
+            runtime.agree_health(True, False)
+            raise
+"""
+
+_CLEANUP_GOOD = """
+    from distributedpytorch_tpu import runtime
+
+    def boundary(err):
+        failed = err is not None
+        runtime.agree_health(failed, False)
+        try:
+            cleanup()
+        finally:
+            close_files()
+"""
+
+
+def test_collective_in_except_positive(tmp_path):
+    found = _lint(tmp_path, {"mod.py": _CLEANUP_BAD_EXCEPT},
+                  rule="collective-in-cleanup")
+    assert len(found) == 1
+    assert "except" in found[0].message
+
+
+def test_collective_in_finally_positive(tmp_path):
+    found = _lint(tmp_path, {"mod.py": _CLEANUP_BAD_FINALLY},
+                  rule="collective-in-cleanup")
+    assert len(found) == 1
+    assert "finally" in found[0].message
+
+
+def test_collective_in_cleanup_rationale_comment_silences(tmp_path):
+    assert _lint(tmp_path, {"mod.py": _CLEANUP_RATIONALE},
+                 rule="collective-in-cleanup") == []
+
+
+def test_collective_outside_cleanup_negative(tmp_path):
+    assert _lint(tmp_path, {"mod.py": _CLEANUP_GOOD},
+                 rule="collective-in-cleanup") == []
+
+
 # -- CLI contract ------------------------------------------------------
 
 def test_repo_lints_clean_via_run_cli(capsys):

@@ -170,6 +170,59 @@ def test_unshared_boundaries_fall_back_with_warning(tmp_path):
     assert any("health_boundary" in w for w in result["warnings"])
 
 
+def _stamp2(t):
+    """A third rank with its own mono origin and no wall skew."""
+    return {"ts": _WALL0 + t, "mono": 9000.0 + t, "rank": 2}
+
+
+def test_mixed_alignment_isolates_boundaryless_rank(tmp_path):
+    # The elastic rank-loss shape: ranks 0/1 share boundaries; rank 2
+    # died mid-epoch 0, before its first health_boundary.  One rank's
+    # truncation must not cost the others their precise alignment.
+    rsl = _two_rank_run(str(tmp_path))
+    _write_rank(rsl, 2, [
+        {"kind": "span", "name": "epoch", "dur_s": 0.9, **_stamp2(1.0)},
+        {"kind": "event", "name": "anomaly", **_stamp2(1.1)},
+    ])
+    result = timeline.build_timeline(rsl)
+    assert result["alignment"] == "mixed"
+    assert result["ranks"] == [0, 1, 2]
+    assert any("rank 2" in w and "wall clock" in w
+               for w in result["warnings"])
+    # ranks 0/1 keep the boundary-precise alignment despite the mix
+    instants = {e["pid"]: e["ts"]
+                for e in result["trace"]["traceEvents"]
+                if e["ph"] == "i" and e["name"] == "health_boundary"
+                and e["args"].get("epoch") == 0}
+    assert instants[0] == pytest.approx(instants[1], abs=1.0)  # µs
+    # rank 2's truncated stream still lands in the trace
+    assert any(e.get("pid") == 2 and e["ph"] == "X"
+               for e in result["trace"]["traceEvents"])
+
+
+def test_elastic_reconfigure_boundary_is_named(tmp_path):
+    # Survivors emit elastic/reconfigure; the departed rank's stream
+    # just truncates.  The merged timeline must say so — a shrunken
+    # world should read as a reconfigure, not as data loss.
+    rsl = _two_rank_run(str(tmp_path))
+    _write_rank(rsl, 2, [
+        {"kind": "span", "name": "epoch", "dur_s": 0.9, **_stamp2(1.0)},
+    ])
+    for rank in (0, 1):
+        _write_rank(rsl, rank, [
+            _event(rank, "elastic/reconfigure", 4.5, generation=1,
+                   old_world=3, new_world=2),
+        ])
+    result = timeline.build_timeline(rsl)
+    named = [w for w in result["warnings"]
+             if "elastic reconfigure" in w]
+    assert len(named) == 1
+    assert "generation(s) [1]" in named[0]
+    assert "survivors [0, 1]" in named[0]
+    assert "rank(s) [2] departed" in named[0]
+    assert "not data loss" in named[0]
+
+
 # -- trace contract ----------------------------------------------------
 
 
