@@ -267,20 +267,34 @@ def bench_ours_streaming(batch_per_replica: int, model_name: str = "cnn",
         runtime.replicated_sharding(mesh))
     key = utils.root_key(1234)
 
-    def one_epoch(epoch: int) -> float:
+    # Per-step host-loop intervals stream into the telemetry sketch
+    # (fixed memory, every step covered — not a first-N sample), so the
+    # row reports tail latency next to the mean-derived throughput: a
+    # straggly p99 with a healthy p50 is the queue hiccuping, which a
+    # samples/sec average hides completely.
+    from distributedpytorch_tpu.telemetry import Histogram
+
+    step_hist = Histogram("bench/step_host_s")
+
+    def one_epoch(epoch: int, hist=None) -> float:
         nonlocal state
         last = None
+        prev = time.perf_counter()
         for images, labels, valid in loader.epoch(epoch):
             state, metrics = engine.train_step(state, images, labels,
                                                valid, key)
             last = metrics["loss"]
+            if hist is not None:
+                now = time.perf_counter()
+                hist.observe(now - prev)
+                prev = now
         jax.block_until_ready(last)
         return time.monotonic()
 
     one_epoch(0)  # compile + warmup epoch
     t0 = time.monotonic()
     for e in range(1, 1 + epochs):
-        t1 = one_epoch(e)
+        t1 = one_epoch(e, hist=step_hist)
     elapsed = t1 - t0
     samples = epochs * len(loader) * loader.global_batch
     sps = samples / elapsed
@@ -320,10 +334,13 @@ def bench_ours_streaming(batch_per_replica: int, model_name: str = "cnn",
         jax.block_until_ready(m["loss"])
     t_disp = (time.monotonic() - t0) / 20
 
+    hs = step_hist.summary()
     out = {"model": model_name, "batch_per_replica": batch_per_replica,
            "mode": "streaming", "producer_threads": producer_threads,
            "samples_per_sec": sps,
            "samples_per_sec_per_chip": sps / n_chips, "n_chips": n_chips,
+           "step_host_ms": {q: round(hs[q] * 1e3, 3)
+                            for q in ("p50", "p95", "p99") if q in hs},
            "steps": epochs * len(loader), "elapsed_s": elapsed,
            "device_kind": jax.devices()[0].device_kind,
            "decomposition_ms_per_step": {

@@ -1244,6 +1244,99 @@ class CollectiveInCleanup(Rule):
                             f"provably gets here")
 
 
+# -- 13. wall-clock-in-measurement ------------------------------------
+
+class WallClockInMeasurement(Rule):
+    """``time.time()`` in a subtraction is a duration measured on the
+    wall clock — which NTP can step backwards or slew mid-interval, so
+    the "duration" can come out negative or off by the adjustment.  The
+    repo's clock contract (telemetry.py docstring) is three-way: ``ts``
+    = time.time() stamp for humans, NEVER subtracted; ``mono`` =
+    time.monotonic() for cross-record ordering; durations via
+    time.perf_counter().  The ledger/timeline/flightrec reconciliation
+    all assume it — one wall-clock duration corrupts a whole epoch row.
+    Flags ``time.time()`` appearing as a subtraction operand, directly
+    or through a variable assigned from it.  Deliberate exceptions
+    (e.g. comparing two wall stamps ACROSS hosts, where wall clock is
+    the point) carry a rationale comment on the line or the line above,
+    same contract as bare-except."""
+
+    name = "wall-clock-in-measurement"
+    description = ("time.time() used in a subtraction (duration on the "
+                   "wall clock) — stamp with time(), measure with "
+                   "perf_counter()")
+
+    def _has_rationale(self, mod: Module, line: int) -> bool:
+        return mod.has_comment(line) or (line - 1) in mod.comment_lines
+
+    def _is_wall_call(self, node: ast.AST) -> bool:
+        return isinstance(node, ast.Call) \
+            and call_name(node) == "time.time"
+
+    def _walk_scope(self, scope: ast.AST) -> Iterator[ast.AST]:
+        """Walk a scope WITHOUT descending into nested functions — a
+        name bound from time.time() in one function is a different
+        binding in another, and leaking taint across scopes turns the
+        rule into noise."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _tainted(self, scope: ast.AST) -> Set[str]:
+        """Names bound to a raw time.time() result in this scope."""
+        out: Set[str] = set()
+        for node in self._walk_scope(scope):
+            if isinstance(node, ast.Assign) \
+                    and self._is_wall_call(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            scopes: List[ast.AST] = [mod.tree] + [
+                n for n in ast.walk(mod.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+            for scope in scopes:
+                tainted = self._tainted(scope)
+                for node in self._walk_scope(scope):
+                    if isinstance(node, ast.BinOp) \
+                            and isinstance(node.op, ast.Sub):
+                        operands = (node.left, node.right)
+                    elif isinstance(node, ast.AugAssign) \
+                            and isinstance(node.op, ast.Sub):
+                        operands = (node.target, node.value)
+                    else:
+                        continue
+                    culprit = None
+                    for opnd in operands:
+                        if self._is_wall_call(opnd):
+                            culprit = "time.time()"
+                            break
+                        if isinstance(opnd, ast.Name) \
+                                and opnd.id in tainted:
+                            culprit = (f"{opnd.id!r} (assigned from "
+                                       f"time.time())")
+                            break
+                    if culprit is None:
+                        continue
+                    if self._has_rationale(mod, node.lineno):
+                        continue
+                    yield self.finding(
+                        mod, node.lineno,
+                        f"{culprit} in a subtraction measures a "
+                        f"duration on the wall clock, which NTP can "
+                        f"step mid-interval — use time.perf_counter() "
+                        f"for durations (clock contract: ts=stamp, "
+                        f"mono=ordering, perf_counter=duration), or "
+                        f"comment why wall time is the point here")
+
+
 RULES = (
     HostSyncInStepLoop(),
     TraceImpurity(),
@@ -1257,6 +1350,7 @@ RULES = (
     ProfilerTraceLeak(),
     MixedPrecisionAccum(),
     CollectiveInCleanup(),
+    WallClockInMeasurement(),
 )
 
 RULES_BY_NAME = {r.name: r for r in RULES}

@@ -53,7 +53,7 @@ import numpy as np
 from flax import serialization
 from jax.sharding import NamedSharding, PartitionSpec
 
-from . import faults, runtime, telemetry
+from . import faults, goodput, runtime, telemetry
 from .models import vit_pipeline
 from .train.engine import TrainState
 
@@ -380,8 +380,12 @@ def save_checkpoint(path: str, model_name: str, state: TrainState,
     state (the internal call below is then a no-op; it only covers
     single-host callers).  For orbax, EVERY process calls this (each host
     writes its own shards) and no gather happens at all."""
-    with telemetry.get().span("ckpt_save", fmt=fmt, epoch=int(epoch),
-                              file=os.path.basename(path)):
+    # Goodput: the sync save blocks the driver for its whole duration
+    # (ckpt_blocking); the ledger only counts main-thread time, so the
+    # same code running on the AsyncSaver worker is correctly excluded.
+    with goodput.get().timed("ckpt_blocking"), \
+            telemetry.get().span("ckpt_save", fmt=fmt, epoch=int(epoch),
+                                 file=os.path.basename(path)):
         if fmt == "orbax":
             return _save_orbax(path, model_name, state, epoch,
                                best_valid_loss)
@@ -539,7 +543,8 @@ def save_checkpoint_async(saver: AsyncSaver, path: str, model_name: str,
 
     attrs = dict(fmt=fmt, epoch=int(epoch), file=os.path.basename(path))
     if fmt == "orbax":
-        with tel.span("ckpt_save_blocking", **attrs):
+        with goodput.get().timed("ckpt_blocking"), \
+                tel.span("ckpt_save_blocking", **attrs):
             saver.wait()
             import orbax.checkpoint as ocp
 
@@ -562,7 +567,8 @@ def save_checkpoint_async(saver: AsyncSaver, path: str, model_name: str,
         saver.submit(finalize)
         return
 
-    with tel.span("ckpt_save_blocking", **attrs):
+    with goodput.get().timed("ckpt_blocking"), \
+            tel.span("ckpt_save_blocking", **attrs):
         payload = _msgpack_payload(model_name, state, epoch,
                                    best_valid_loss)
 
@@ -912,8 +918,9 @@ def load_checkpoint(path: str, state: TrainState,
     best_valid_loss).  ``state`` is a template with the right structure
     (fresh Engine.init_state output); restored arrays replace its leaves.
     Format is auto-detected: an orbax checkpoint is a directory."""
-    with telemetry.get().span("ckpt_restore",
-                              file=os.path.basename(path)):
+    with goodput.get().timed("ckpt_blocking"), \
+            telemetry.get().span("ckpt_restore",
+                                 file=os.path.basename(path)):
         return _load_checkpoint_inner(path, state, restore_optimizer)
 
 

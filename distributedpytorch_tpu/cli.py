@@ -20,8 +20,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import checkpoint as ckpt
-from . import costs, elastic, faults, flightrec, parallel, runtime, \
-    telemetry, utils
+from . import costs, elastic, faults, flightrec, goodput, parallel, \
+    runtime, telemetry, utils
 from .config import Config, config_from_argv
 from .data import augment  # noqa: F401  (re-exported for drivers/tests)
 from .data.datasets import Dataset, Split, load_dataset
@@ -297,6 +297,7 @@ def _aot_warmup(cfg: Config, engine: Engine, state, train_loader,
             costs.record("eval_step", engine.eval_step.lower(
                 state, img, lbl, vld).compile())
     warmup_s = time.perf_counter() - t0
+    goodput.get().add("compile", warmup_s)
     hit = runtime.compilation_cache_hits() > hits_before
     tel.gauge("compile/warmup_s").set(warmup_s)
     tel.gauge("compile/cache_hit").set(1.0 if hit else 0.0)
@@ -322,9 +323,15 @@ def _aot_warmup(cfg: Config, engine: Engine, state, train_loader,
 
 def _run_eval_pass(engine: Engine, state, loader, epoch: int
                    ) -> tuple[float, float]:
-    """One no-grad pass; returns globally-reduced (loss, accuracy)."""
+    """One no-grad pass; returns globally-reduced (loss, accuracy).
+
+    The whole pass is goodput ``compute``: eval batches come from an
+    already-warm loader and the pass is dominated by dispatch; nested
+    hooks (a retried read, a fault sleep) still claim their own
+    categories out of the window (goodput.timed's non-overlap rule)."""
     tel = telemetry.get()
-    with tel.span("eval_pass", epoch=epoch, steps=len(loader)):
+    with goodput.get().timed("compute"), \
+            tel.span("eval_pass", epoch=epoch, steps=len(loader)):
         if isinstance(loader, ResidentLoader):
             idx, valid = loader.epoch_plan(epoch)
             totals = engine.eval_epoch(state, loader.images, loader.labels,
@@ -368,8 +375,9 @@ def _run_train_pass(engine: Engine, state, loader, epoch: int, key
         # the real compute wall-clock, and the StepTraceAnnotation makes
         # the dispatch findable in a --profile trace by the same name.
         idx, valid = loader.epoch_plan(epoch)
-        with jax.profiler.StepTraceAnnotation("train_dispatch",
-                                              step_num=epoch), \
+        with goodput.get().timed("compute"), \
+                jax.profiler.StepTraceAnnotation("train_dispatch",
+                                                 step_num=epoch), \
                 tel.span("train_dispatch", epoch=epoch, steps=nb_iters):
             state, metrics = engine.train_epoch(
                 state, loader.images, loader.labels, idx, valid, key)
@@ -398,11 +406,14 @@ def _run_train_pass(engine: Engine, state, loader, epoch: int, key
     # set.  With BOTH disabled the off path runs the original loop with
     # zero added per-step work.
     rec = flightrec.get()
-    instrument = tel.enabled or rec.enabled
+    gp = goodput.get()
+    exporter = goodput.exporter()
+    instrument = tel.enabled or rec.enabled or gp.enabled
     step_hist = tel.histogram("step/dispatch_s") if tel.enabled else None
     depth_fn = getattr(loader, "lookahead_depth", None)
     loss_hist, correct_hist, valid_hist = [], [], []
     prev_end = time.perf_counter() if instrument else 0.0
+    gp.begin_steps()
     dispatch_s = 0.0
     for i, (images, labels, valid) in enumerate(loader.epoch(epoch)):
         if instrument:
@@ -424,6 +435,12 @@ def _run_train_pass(engine: Engine, state, loader, epoch: int, key
             print(f"\r{epoch:03d} {i / nb_iters * 100:.0f}%", end="\r")
         if instrument:
             end = time.perf_counter()
+            # Goodput attribution for the step: dispatch -> compute,
+            # inter-step wait -> data_wait (this is the ONLY place the
+            # loader's blocking time is attributed — see pipeline.py).
+            category = gp.step(dispatch_s, t0 - prev_end)
+            if exporter is not None:
+                exporter.note_step()
             # step_s spans yield-to-yield (wait + dispatch + host book-
             # keeping): the quantity the anomaly detector judges, since
             # a straggler can hide in any slice of it.
@@ -431,9 +448,12 @@ def _run_train_pass(engine: Engine, state, loader, epoch: int, key
                 rec, epoch=epoch, step=i, step_s=end - prev_end,
                 dispatch_s=dispatch_s, wait_s=t0 - prev_end,
                 queue_depth=(depth_fn(epoch) if depth_fn is not None
-                             else None))
+                             else None),
+                category=category)
             prev_end = end
-    with runtime.sanctioned_host_transfer():  # ONE sync per epoch
+    gp.end_steps()
+    with gp.timed("compute"), \
+            runtime.sanctioned_host_transfer():  # ONE sync per epoch
         losses, corrects, valids = jax.device_get(
             jnp.stack([jnp.stack(loss_hist), jnp.stack(correct_hist),
                        jnp.stack(valid_hist)]))
@@ -474,8 +494,9 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
             # K fused epochs = ONE dispatch: the span (device_get
             # included) is the real compute wall-clock for the whole
             # chunk, annotated so --profile traces carry the same name.
-            with jax.profiler.StepTraceAnnotation("chunk_dispatch",
-                                                  step_num=epoch), \
+            with goodput.get().timed("compute"), \
+                    jax.profiler.StepTraceAnnotation("chunk_dispatch",
+                                                     step_num=epoch), \
                     tel.span("chunk_dispatch", first_epoch=epoch,
                              epochs=len(chunk)):
                 state, out = engine.train_epochs(
@@ -557,7 +578,9 @@ def _run_train_chunked(cfg: Config, engine: Engine, state, train_loader,
         # (documented trade-off of --epochs-per-dispatch).
         except Exception as e:
             chunk_err = e
-        if _health_boundary(tel, shutdown, chunk[-1], chunk_err, cfg):
+        stop = _health_boundary(tel, shutdown, chunk[-1], chunk_err, cfg)
+        goodput.get().reconcile(chunk[-1])
+        if stop:
             break
     return {"history": history, "best_valid_loss": best_valid_loss,
             "model_name": model_name, "state": state,
@@ -591,6 +614,19 @@ def run_train(cfg: Config) -> dict:
             min_excess_s=cfg.anomaly_min_excess,
             capture_steps=cfg.anomaly_capture_steps,
             max_captures=cfg.anomaly_max_captures)
+    # Goodput ledger: on whenever telemetry is, and forced on by the live
+    # exporter (the /metrics category totals come from it).  The exporter
+    # itself binds port + rank so same-host ranks coexist; /healthz facts
+    # are injected as callables to keep goodput.py stdlib-only.
+    goodput.configure(cfg.rsl_path,
+                      bool(cfg.telemetry or cfg.metrics_port),
+                      rank=runtime.process_index(),
+                      world=runtime.process_count())
+    if cfg.metrics_port:
+        goodput.start_exporter(cfg.metrics_port,
+                               rank=runtime.process_index(),
+                               world_size_fn=runtime.world_size,
+                               generation_fn=elastic.generation)
     costs.reset()
     # Before the first jit compile, so every program of this run can be
     # served from / written to the persistent cache.
@@ -793,24 +829,28 @@ def run_train(cfg: Config) -> dict:
                 # Reconfigure OUTSIDE the except block: the interpreter
                 # exception state (sys.exc_info) holds the traceback
                 # until the block exits, defeating the release above.
-                mesh = _elastic_reconfigure(cfg, tel, saver)
-                if isinstance(train_loader, ShardedLoader):
-                    # Deterministic reshard: same split/settings,
-                    # re-derived rank slices for the new world.
-                    train_loader = train_loader.reshard(mesh)
-                    valid_loader = valid_loader.reshard(mesh)
-                else:  # resident loaders re-place onto the new mesh
-                    train_loader = _make_loader(
-                        cfg, dataset.splits["train"], mesh,
-                        shuffle=True)
-                    valid_loader = _make_loader(
-                        cfg, dataset.splits["valid"], mesh,
-                        shuffle=False)
-                # Resume from the newest lineage-verified snapshot;
-                # None (died before the first save) restarts from
-                # initialization — same as a fresh launch.
-                resume_file = ckpt.newest_checkpoint(
-                    cfg.rsl_path, cfg.dataset, model_name)
+                # The whole park -> rendezvous -> reinit -> reshard
+                # sequence is goodput elastic_reconfigure (the restore
+                # itself lands in ckpt_blocking inside _train_world).
+                with goodput.get().timed("elastic_reconfigure"):
+                    mesh = _elastic_reconfigure(cfg, tel, saver)
+                    if isinstance(train_loader, ShardedLoader):
+                        # Deterministic reshard: same split/settings,
+                        # re-derived rank slices for the new world.
+                        train_loader = train_loader.reshard(mesh)
+                        valid_loader = valid_loader.reshard(mesh)
+                    else:  # resident loaders re-place onto the new mesh
+                        train_loader = _make_loader(
+                            cfg, dataset.splits["train"], mesh,
+                            shuffle=True)
+                        valid_loader = _make_loader(
+                            cfg, dataset.splits["valid"], mesh,
+                            shuffle=False)
+                    # Resume from the newest lineage-verified snapshot;
+                    # None (died before the first save) restarts from
+                    # initialization — same as a fresh launch.
+                    resume_file = ckpt.newest_checkpoint(
+                        cfg.rsl_path, cfg.dataset, model_name)
     finally:
         # Join pending background checkpoint writes FIRST (their spans
         # must land before the close below; a preempted/finished run must
@@ -826,6 +866,10 @@ def run_train(cfg: Config) -> dict:
             # dump from the ordinary end-of-run one.
             flightrec.get().close(
                 "crash" if sys.exc_info()[0] is not None else "run_end")
+            # Exporter down before the ledger closes (a scrape must not
+            # see a half-final ledger), then the final reconcile + write.
+            goodput.stop_exporter()
+            goodput.get().close()
             tel.close()
             runtime.reset_compilation_cache()
 
@@ -993,8 +1037,11 @@ def _health_boundary(tel, shutdown, epoch: int, err, cfg=None) -> bool:
         _peer_loss_exit(tel, epoch, err, elastic_on)
     timeout_s = (cfg.health_timeout if cfg is not None else 0.0) or None
     try:
-        any_failed, any_shutdown = runtime.agree_health(
-            err is not None, shutdown.requested, timeout_s=timeout_s)
+        # The allgather's duration IS the straggler wait: every rank
+        # blocks here until the slowest arrives (goodput collective_skew).
+        with goodput.get().timed("collective_skew"):
+            any_failed, any_shutdown = runtime.agree_health(
+                err is not None, shutdown.requested, timeout_s=timeout_s)
     except faults.HealthTimeoutError as timeout_err:
         # Bounded failure detection: the peer died BETWEEN collectives
         # and never reached this boundary — without the bound the
@@ -1137,7 +1184,12 @@ def _run_train_epochs(cfg: Config, engine: Engine, state, train_loader,
         # allgather on every rank — handling happens in _health_boundary.
         except Exception as e:
             epoch_err = e
-        if _health_boundary(tel, shutdown, epoch, epoch_err, cfg):
+        stop = _health_boundary(tel, shutdown, epoch, epoch_err, cfg)
+        # Epoch-boundary reconciliation AFTER the health allgather so the
+        # window includes its collective_skew; the unattributed remainder
+        # becomes an explicit "other" row entry (goodput.py contract).
+        goodput.get().reconcile(epoch)
+        if stop:
             break
     # Final state is returned so callers (multi-process tests, notebooks)
     # can inspect the trained parameters without re-reading a checkpoint.
@@ -1178,6 +1230,9 @@ def run_test(cfg: Config) -> dict:
     flightrec.configure(cfg.rsl_path, cfg.flightrec,
                         rank=runtime.process_index(),
                         ring_size=cfg.flightrec_ring)
+    goodput.configure(cfg.rsl_path, cfg.telemetry,
+                      rank=runtime.process_index(),
+                      world=runtime.process_count())
     runtime.configure_compilation_cache(cfg.compilation_cache_path())
     mesh = runtime.make_mesh(model_parallel=cfg.model_parallel,
                              seq_parallel=cfg.seq_parallel)
@@ -1214,6 +1269,7 @@ def run_test(cfg: Config) -> dict:
     finally:
         flightrec.get().close(
             "crash" if sys.exc_info()[0] is not None else "run_end")
+        goodput.get().close()
         tel.close()
         runtime.reset_compilation_cache()
     mins, secs = utils.get_duration(start_time, utils.monotonic())
@@ -1247,6 +1303,15 @@ def main(argv=None) -> int:
         # training banners, no JAX backend touched.
         try:
             print(telemetry.report(cfg.rsl_path))
+        except ValueError as e:
+            logging.error(f"{e}, exiting...")
+            return 1
+        return 0
+    if cfg.action == "goodput":
+        # Offline wall-clock attribution summary from the per-rank
+        # goodput ledgers (RSL_PATH/goodput*.json).
+        try:
+            print(goodput.report(cfg.rsl_path))
         except ValueError as e:
             logging.error(f"{e}, exiting...")
             return 1

@@ -240,6 +240,10 @@ class Config:
     # 'timeline' subcommand: merged Chrome trace-event output path
     # (default RSL_PATH/timeline.json).
     timeline_out: Optional[str] = None
+    # Live monitoring: serve Prometheus text at
+    # http://0.0.0.0:(metrics_port + rank)/metrics (and /healthz) for the
+    # life of the run.  0 disables the exporter.
+    metrics_port: int = 0
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -431,6 +435,14 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                    help="flight-recorder ring size: the last N step/"
                         "event records are kept (fixed memory; "
                         "default 4096)")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   dest="metricsPort", metavar="PORT",
+                   help="serve live Prometheus metrics while the run is "
+                        "alive: each rank binds PORT+rank and answers "
+                        "/metrics (counters, gauges, step-time "
+                        "p50/p95/p99, goodput category totals) and "
+                        "/healthz (rank, world size, elastic generation, "
+                        "last-step age); 0 disables (default)")
     p.add_argument("--anomaly-capture", action="store_true",
                    dest="anomalyCapture",
                    help="profile anomalies automatically: when a step "
@@ -556,6 +568,16 @@ def build_parser() -> argparse.ArgumentParser:
                        help=f"run directory holding telemetry/ "
                             f"(default: {RSL_PATH})")
 
+    # Offline goodput summary — reads RSL_PATH/goodput*.json written by
+    # a run with --telemetry or --metrics-port; no train/test flags.
+    p_gp = sub.add_parser(
+        "goodput", help="summarize a run's goodput ledger: per-rank "
+                        "wall-clock attribution by category, fleet "
+                        "aggregate, and the top badput cause")
+    p_gp.add_argument("--rsl_path", type=str, default=RSL_PATH,
+                      help=f"run directory holding goodput*.json "
+                           f"(default: {RSL_PATH})")
+
     # Offline timeline merge — reads RSL_PATH/telemetry/rank*.jsonl +
     # RSL_PATH/flightrec-rank*.json and writes Chrome trace-event JSON
     # (open in Perfetto / chrome://tracing); needs no train/test flags.
@@ -586,6 +608,8 @@ def config_from_argv(argv=None) -> Config:
     args = build_parser().parse_args(argv)
     if args.action == "telemetry":
         return Config(action="telemetry", rsl_path=args.rsl_path)
+    if args.action == "goodput":
+        return Config(action="goodput", rsl_path=args.rsl_path)
     if args.action == "timeline":
         return Config(action="timeline", rsl_path=args.rsl_path,
                       timeline_out=args.out)
@@ -642,6 +666,7 @@ def config_from_argv(argv=None) -> Config:
         moe_experts=args.moeExperts,
         flightrec=args.flightrec,
         flightrec_ring=args.flightrecRing,
+        metrics_port=args.metricsPort,
         anomaly_capture=args.anomalyCapture,
         anomaly_window=args.anomalyWindow,
         anomaly_mad_k=args.anomalyMadK,

@@ -298,6 +298,12 @@ class ShardedLoader:
         HISTOGRAM, so the report prints p50/p95/p99 wait latencies next
         to the totals.  The disabled path is the original loop,
         untouched — no clock reads, no counter lookups per step.
+
+        The goodput ledger (goodput.py) deliberately does NOT hook this
+        iterator: its ``data_wait`` category is charged once, from the
+        train loop's own inter-step wait window (cli._run_train_pass),
+        which already contains any blocking that happens here.  Charging
+        both would double-count and break the sums-to-wall invariant.
         """
         tel = telemetry.get()
         if self.producer_threads > 0:

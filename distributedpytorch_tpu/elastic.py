@@ -84,7 +84,7 @@ import socket
 import time
 from typing import List, Optional
 
-from . import faults, flightrec, telemetry
+from . import faults, flightrec, goodput, telemetry
 
 # Leaked prior-generation (client, service) handles — see module doc.
 # Never cleared: clearing is exactly the crash we are avoiding.
@@ -199,6 +199,11 @@ def quiesce_exit(rc: int) -> None:
     A coordination-service host additionally waits for its peers' done
     markers (see _exit_barrier) so its exit cannot abort them."""
     try:
+        # Exporter first (a scrape must not observe the half-final
+        # ledger), then the goodput final reconcile + write, then the
+        # telemetry/flightrec sinks.
+        goodput.stop_exporter()
+        goodput.get().close()
         telemetry.get().close()
         flightrec.get().close("run_end")
     except Exception:  # broad: nothing may stop the exit path

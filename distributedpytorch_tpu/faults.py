@@ -76,7 +76,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple, TypeVar
 
-from . import flightrec, telemetry
+from . import flightrec, goodput, telemetry
 
 T = TypeVar("T")
 
@@ -433,7 +433,12 @@ class RetryPolicy:
                 logging.warning(
                     f"{site}: transient failure (attempt {attempt}/"
                     f"{self.max_attempts}), retrying in {delay:.3f}s: {e}")
-                time.sleep(delay)
+                # The backoff sleep is goodput retry_backoff — attributed
+                # here, at the one place every retry sleeps, so ledger
+                # windows that enclose a retried call (ckpt_blocking,
+                # data_wait) shrink by it instead of double-counting.
+                with goodput.get().timed("retry_backoff"):
+                    time.sleep(delay)
 
 
 _default_policy = RetryPolicy()

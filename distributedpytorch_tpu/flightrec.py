@@ -66,7 +66,7 @@ import threading
 import time
 from typing import Any, Deque, Dict, List, Optional
 
-from . import telemetry
+from . import goodput, telemetry
 
 
 class AnomalyDetector:
@@ -156,8 +156,11 @@ class AnomalyDetector:
         path = os.path.join(self.trace_dir,
                             f"capture-{self.captures_started}")
         try:
-            os.makedirs(path, exist_ok=True)
-            jax.profiler.start_trace(path)
+            # The profiler's own start cost is goodput anomaly_capture
+            # overhead — the capture is diagnosis, not training.
+            with goodput.get().timed("anomaly_capture"):
+                os.makedirs(path, exist_ok=True)
+                jax.profiler.start_trace(path)
         except Exception as e:  # profiling is advisory, never fatal
             logging.warning(f"flightrec: start_trace failed ({e}); "
                             f"anomaly recorded without a capture")
@@ -173,7 +176,10 @@ class AnomalyDetector:
         import jax
 
         try:
-            jax.profiler.stop_trace()
+            # stop_trace serializes the capture to disk — goodput
+            # anomaly_capture overhead, same as the start.
+            with goodput.get().timed("anomaly_capture"):
+                jax.profiler.stop_trace()
         except Exception as e:
             # advisory: a failed stop (backend died mid-capture) must
             # not take the training loop down with it
@@ -226,10 +232,13 @@ class FlightRecorder:
     def record_step(self, *, epoch: int, step: int, step_s: float,
                     dispatch_s: Optional[float] = None,
                     wait_s: Optional[float] = None,
-                    queue_depth: Optional[int] = None) -> None:
+                    queue_depth: Optional[int] = None,
+                    category: Optional[str] = None) -> None:
         """One completed train step: total step wall time, the dispatch
-        slice of it, the data-wait slice, and the prefetch queue depth
-        sampled after the fetch."""
+        slice of it, the data-wait slice, the prefetch queue depth
+        sampled after the fetch, and the step's dominant goodput
+        category — so a crash/preempt dump shows where the rank was
+        spending its time when it died, not just how long steps took."""
         if not self.enabled:
             return
         rec: Dict[str, Any] = {"kind": "step", "epoch": epoch,
@@ -242,6 +251,8 @@ class FlightRecorder:
             rec["wait_s"] = wait_s
         if queue_depth is not None:
             rec["queue_depth"] = queue_depth
+        if category is not None:
+            rec["category"] = category
         with self._lock:
             self._ring.append(rec)
 
@@ -340,13 +351,14 @@ def attach_detector(rec: FlightRecorder, *, trace_dir: str,
 def observe_step(rec: FlightRecorder, *, epoch: int, step: int,
                  step_s: float, dispatch_s: Optional[float] = None,
                  wait_s: Optional[float] = None,
-                 queue_depth: Optional[int] = None) -> None:
+                 queue_depth: Optional[int] = None,
+                 category: Optional[str] = None) -> None:
     """Hot-loop helper: record the step and, if a detector is attached,
     judge it — emitting the ``anomaly`` event on both sinks when it
     fires."""
     rec.record_step(epoch=epoch, step=step, step_s=step_s,
                     dispatch_s=dispatch_s, wait_s=wait_s,
-                    queue_depth=queue_depth)
+                    queue_depth=queue_depth, category=category)
     det = rec.detector
     if det is None:
         return

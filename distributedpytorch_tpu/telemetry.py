@@ -108,12 +108,23 @@ class Gauge:
 
 
 class Histogram:
-    """Timing histogram: stores observations (bounded), summarized at
-    flush with count/sum/min/max/mean and p50/p90/p95/p99."""
+    """Streaming timing histogram: log-bucketed quantile sketch with
+    exact count/sum/min/max, summarized at flush with p50/p90/p95/p99.
 
-    __slots__ = ("name", "count", "sum", "min", "max", "_samples")
+    The previous implementation kept the FIRST 4096 raw samples, so on
+    long runs the quantiles described the warmup, not the run — and
+    they only existed at close time.  The sketch keeps one counter per
+    geometric bucket (2% growth => <=1% representative error, far under
+    the report's precision), is O(1) per observe with bounded memory
+    regardless of run length, covers every observation, and is
+    queryable at any moment — ``quantile()`` backs the live ``/metrics``
+    exporter (goodput.py) as well as the close-time summary event.
+    """
 
-    MAX_SAMPLES = 4096  # bounds memory on long runs; quantiles from these
+    __slots__ = ("name", "count", "sum", "min", "max", "_buckets",
+                 "_nonpos")
+
+    _GROWTH_LOG = math.log(1.02)  # bucket boundaries grow 2% per index
 
     def __init__(self, name: str):
         self.name = name
@@ -121,7 +132,8 @@ class Histogram:
         self.sum = 0.0
         self.min = math.inf
         self.max = -math.inf
-        self._samples: List[float] = []
+        self._buckets: Dict[int, int] = {}
+        self._nonpos = 0  # observations <= 0 (durations shouldn't, but)
 
     def observe(self, value: float) -> None:
         value = float(value)
@@ -129,18 +141,37 @@ class Histogram:
         self.sum += value
         self.min = min(self.min, value)
         self.max = max(self.max, value)
-        if len(self._samples) < self.MAX_SAMPLES:
-            self._samples.append(value)
+        if value <= 0.0:
+            self._nonpos += 1
+            return
+        idx = math.floor(math.log(value) / self._GROWTH_LOG)
+        self._buckets[idx] = self._buckets.get(idx, 0) + 1
+
+    def quantile(self, q: float) -> float:
+        """Streaming quantile (bucket geometric midpoint, clamped to the
+        exact observed range).  Safe to call from a scrape thread while
+        the driver observes: the snapshot below is a single C-level op."""
+        if not self.count:
+            return 0.0
+        target = min(self.count - 1, int(q * self.count))
+        cum = self._nonpos
+        if cum > target:
+            return self.min
+        for idx, n in sorted(self._buckets.items()):
+            cum += n
+            if cum > target:
+                rep = math.exp((idx + 0.5) * self._GROWTH_LOG)
+                return min(self.max, max(self.min, rep))
+        return self.max
 
     def summary(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"count": self.count, "sum": self.sum}
         if not self.count:
             return out
         out.update(min=self.min, max=self.max, mean=self.sum / self.count)
-        s = sorted(self._samples)
         for q, label in ((0.5, "p50"), (0.9, "p90"), (0.95, "p95"),
                          (0.99, "p99")):
-            out[label] = s[min(len(s) - 1, int(q * len(s)))]
+            out[label] = self.quantile(q)
         return out
 
 
@@ -242,6 +273,14 @@ class Telemetry:
         if h is None:
             h = self._histograms[name] = Histogram(name)
         return h
+
+    def metrics_snapshot(self):
+        """Stable views of the live registries for out-of-band readers
+        (the /metrics exporter's scrape threads).  The list() copies are
+        single C-level operations — atomic under the GIL even while the
+        driver thread is registering new metrics."""
+        return (list(self._counters.values()), list(self._gauges.values()),
+                list(self._histograms.values()))
 
     def span(self, name: str, **attrs: Any):
         """Timed context manager; emits a span event on exit.  The
@@ -490,10 +529,28 @@ def render_report(agg: Dict[str, Any]) -> str:
                     if e.get("name") == "run_start"), default=0)
     if expected > len(agg["ranks"]):
         missing = sorted(set(range(expected)) - set(agg["ranks"]))
-        lines.append(f"WARNING: {expected} process(es) ran but only "
-                     f"{len(agg['ranks'])} rank file(s) readable — "
-                     f"rank(s) {missing} skipped (telemetry writer "
-                     f"disabled or file lost)")
+        # An elastic run loses ranks by design: every survivor emits an
+        # elastic/reconfigure event carrying the shrunken new_world.
+        # Missing rank slots at/above the smallest surviving world
+        # departed in a reconfigure — a note, not a writer failure;
+        # anything below it really is a lost/disabled writer.
+        worlds = [int(e["attrs"]["new_world"]) for e in agg["events"]
+                  if e.get("name") == "elastic/reconfigure"
+                  and isinstance(e.get("attrs"), dict)
+                  and isinstance(e["attrs"].get("new_world"), int)]
+        final_world = min(worlds) if worlds else expected
+        departed = [r for r in missing if r >= final_world]
+        missing = [r for r in missing if r < final_world]
+        if departed:
+            lines.append(f"note: rank(s) {departed} departed in an "
+                         f"elastic reconfigure (world shrank to "
+                         f"{final_world}); their files ending early — "
+                         f"or never landing — is expected, not loss")
+        if missing:
+            lines.append(f"WARNING: {expected} process(es) ran but only "
+                         f"{len(agg['ranks'])} rank file(s) readable — "
+                         f"rank(s) {missing} skipped (telemetry writer "
+                         f"disabled or file lost)")
 
     spans = agg["spans"]
     if spans:

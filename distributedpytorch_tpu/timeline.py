@@ -6,7 +6,10 @@ JSONL (telemetry/rank*.jsonl) and flight-recorder dump
 Perfetto (https://ui.perfetto.dev) or chrome://tracing loads directly:
 one process row per rank, telemetry spans and flight-recorder steps on
 separate threads, point events (anomaly, fault_injected, preempt_signal,
-health_boundary) as instants.
+health_boundary) as instants, and — when the run wrote a goodput ledger
+(goodput*.json) — a per-rank category track: one slice per reconcile
+window named by its dominant category plus a stacked counter series of
+the full category mix.
 
 Clock alignment.  Each rank stamps records with its own ``mono`` clock,
 whose origin is arbitrary per process — raw mono values from two ranks
@@ -48,17 +51,41 @@ import os
 import statistics
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import flightrec, telemetry
+from . import flightrec, goodput, telemetry
 
 # Thread ids within each rank's process row.
 _TID_SPANS = 0      # telemetry spans
 _TID_STEPS = 1      # flight-recorder per-step records
 _TID_EVENTS = 2     # point events / instants
+_TID_GOODPUT = 3    # goodput ledger: per-epoch category attribution
 
 
 def _attrs(ev: Dict[str, Any]) -> Dict[str, Any]:
     a = ev.get("attrs")
     return a if isinstance(a, dict) else {}
+
+
+def _goodput_rows(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """The plottable per-window rows of one rank's ledger: mono END
+    stamp, positive wall_s window, and a category map — anything torn
+    or hand-edited is dropped, never crashed on."""
+    rows = []
+    for row in doc.get("epochs", []):
+        if not isinstance(row, dict) \
+                or not isinstance(row.get("mono"), (int, float)) \
+                or not isinstance(row.get("wall_s"), (int, float)) \
+                or not isinstance(row.get("categories"), dict):
+            continue
+        if float(row["wall_s"]) <= 0:
+            continue
+        rows.append({"epoch": row.get("epoch"),
+                     "mono": float(row["mono"]),
+                     "wall_s": float(row["wall_s"]),
+                     "residual_s": row.get("residual_s"),
+                     "categories": {str(k): float(v)
+                                    for k, v in row["categories"].items()
+                                    if isinstance(v, (int, float))}})
+    return rows
 
 
 def _boundaries(events: List[Dict[str, Any]]
@@ -221,6 +248,7 @@ def build_timeline(rsl_path: str) -> Dict[str, Any]:
     telemetry at all; every lesser defect degrades with a warning."""
     events = telemetry.load_events(os.path.join(rsl_path, "telemetry"))
     dumps = flightrec.load_dumps(rsl_path)
+    ledgers = goodput.load_ledgers(rsl_path)
     ranks = sorted({int(ev["rank"]) for ev in events
                     if isinstance(ev.get("rank"), int)} | set(dumps))
     if not ranks:
@@ -233,6 +261,10 @@ def build_timeline(rsl_path: str) -> Dict[str, Any]:
             warnings.append(f"no flight record for rank {r} "
                             f"(flightrec-rank{r}.json missing/unreadable); "
                             "timeline shows telemetry spans only")
+    if not ledgers:
+        warnings.append("no goodput ledger (goodput*.json missing — run "
+                        "predates the ledger or was killed before its "
+                        "final write); timeline omits the category track")
     # Elastic reconfigure boundary (elastic.py): every survivor emits an
     # elastic/reconfigure event; a rank present in the run but absent
     # from that set is the departed one — its stream simply truncates at
@@ -276,6 +308,10 @@ def build_timeline(rsl_path: str) -> Dict[str, Any]:
                 if isinstance(rec.get("step_s"), (int, float)):
                     t -= float(rec["step_s"])
                 stamps.append(t)
+    for r, doc in ledgers.items():
+        for row in _goodput_rows(doc):
+            # Ledger rows carry END stamps; the slice starts wall_s back.
+            stamps.append(aligned(r, row["mono"] - row["wall_s"]))
     if not stamps:
         raise ValueError(
             f"no timestamped records under {rsl_path!r}; nothing to plot")
@@ -292,7 +328,10 @@ def build_timeline(rsl_path: str) -> Dict[str, Any]:
                              "pid": r, "args": {"sort_index": r}})
         for tid, label in ((_TID_SPANS, "telemetry spans"),
                            (_TID_STEPS, "flightrec steps"),
-                           (_TID_EVENTS, "events")):
+                           (_TID_EVENTS, "events"),
+                           (_TID_GOODPUT, "goodput categories")):
+            if tid == _TID_GOODPUT and r not in ledgers:
+                continue
             trace_events.append({"ph": "M", "name": "thread_name",
                                  "pid": r, "tid": tid,
                                  "args": {"name": label}})
@@ -344,6 +383,31 @@ def build_timeline(rsl_path: str) -> Dict[str, Any]:
                     "args": {k: v for k, v in rec.items()
                              if k not in ("kind", "name", "ts", "mono")},
                 })
+    # Goodput ledger track: one slice per reconcile window, named by the
+    # window's dominant category (full map in args), plus a Chrome
+    # counter ("C") event per window so Perfetto draws the category mix
+    # as a stacked area over the run.
+    for r, doc in ledgers.items():
+        for row in _goodput_rows(doc):
+            cats = row["categories"]
+            start = us(r, row["mono"] - row["wall_s"])
+            top = max(cats, key=cats.get) if cats else "other"
+            label = ("final" if row["epoch"] is None
+                     else f"epoch {row['epoch']}")
+            args = dict(cats)
+            if row["residual_s"] is not None:
+                args["residual_s"] = row["residual_s"]
+            trace_events.append({
+                "ph": "X", "cat": "goodput",
+                "name": f"{label}: {top}", "pid": r,
+                "tid": _TID_GOODPUT, "ts": start,
+                "dur": round(row["wall_s"] * 1e6, 3), "args": args,
+            })
+            trace_events.append({
+                "ph": "C", "cat": "goodput", "name": "goodput (s)",
+                "pid": r, "tid": _TID_GOODPUT, "ts": start,
+                "args": cats,
+            })
     # Stable per-rank ordering: metadata first, then strictly by
     # (pid, ts) — Perfetto tolerates any order, humans and tests don't.
     trace_events.sort(key=lambda e: (e.get("pid", -1),

@@ -28,12 +28,18 @@
 #      false-positive check; recorder overhead budget; 2-rank timeline
 #      merge — see scripts/anomaly_gate.py and README "Flight recorder,
 #      anomaly profiling & timeline"
-#   7. elastic gate: a 3-process gloo world with --elastic loses a rank
+#   7. goodput gate: the wall-clock ledger must account >=99% of a
+#      canned badput run (stall -> data_wait, ckpt retries ->
+#      retry_backoff), serve valid live /metrics while the run is
+#      alive, surface the timeline category track, and stay inside the
+#      exporter overhead budget — see scripts/goodput_gate.py and
+#      README "Goodput & live monitoring"
+#   8. elastic gate: a 3-process gloo world with --elastic loses a rank
 #      mid-epoch; survivors must shrink to 2, resume from the newest
 #      snapshot, and finish with params allclose-identical to an
 #      uninterrupted 2-rank reference — see scripts/chaos_gate.py
 #      --stage elastic and README "Elastic training"
-#   8. the driver's own gate: __graft_entry__.dryrun_multichip(8)
+#   9. the driver's own gate: __graft_entry__.dryrun_multichip(8)
 #      (clean env, exactly as the driver runs it)
 #
 # Tier map:
@@ -80,6 +86,9 @@ env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py
 
 echo "== gate: anomaly (flight recorder / capture / timeline) =="
 env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/anomaly_gate.py
+
+echo "== gate: goodput (wall-clock ledger / live metrics) =="
+env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/goodput_gate.py
 
 echo "== gate: elastic (rank loss / shrink / resume parity) =="
 env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py --stage elastic

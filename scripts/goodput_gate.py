@@ -1,0 +1,262 @@
+#!/usr/bin/env python3
+"""Goodput ledger + live /metrics gate leg (scripts/gate.sh), on CPU.
+
+Three stages, all bounded:
+
+  A. attribution under injected badput — a 2-epoch synthetic run under
+     a canned plan combining a 0.5 s ``data.host_batch`` stall with two
+     transient ``ckpt.save`` I/O errors, with the live exporter on.
+     The ledger (RSL/goodput.json) must account >= 99% of wall clock
+     (the residual is an explicit category, never hidden), land the
+     stall in data_wait, and land the retry sleeps in retry_backoff —
+     WITHOUT the enclosing ckpt_blocking window double-counting them.
+     While the run is alive a scraper thread polls the exporter:
+     /metrics must parse as Prometheus text carrying the goodput
+     counters, /healthz as JSON naming the rank.
+  B. artifact surfaces — ``main.py goodput`` summarizes the run and
+     names the top badput cause; ``main.py timeline`` on the same dir
+     carries the per-rank goodput category track.
+  C. exporter overhead budget — min-of-2 timed runs with --metrics-port
+     on (under continuous scraping) vs off (same run dir per variant so
+     run 2 hits the compile cache) must stay within 2% (+0.6 s absolute
+     floor for scheduler noise on these short CPU runs).
+
+Run as ``env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/goodput_gate.py``.
+"""
+
+import json
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+import subprocess
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+OVERHEAD_REL = 0.02     # exporter-on budget vs exporter-off
+OVERHEAD_ABS_S = 0.6    # noise floor for short CPU runs
+RESIDUAL_MAX = 0.01     # ledger must attribute >= 99% of wall clock
+
+# One 0.5 s stall late in epoch 0 (lands in the driver's inter-step
+# wait window -> data_wait) plus two transient ckpt.save I/O errors
+# (the sync saver's RetryPolicy sleeps on the driver -> retry_backoff,
+# nested inside ckpt_blocking exactly once).
+PLAN = "data.host_batch:stall:12:1:0.5;ckpt.save:ioerror:0:2"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _base_cfg(rsl: str, **overrides):
+    from distributedpytorch_tpu.config import Config
+
+    return Config(action="train", data_path="/nodata", rsl_path=rsl,
+                  dataset="synthetic", model_name="mlp", batch_size=8,
+                  nb_epochs=2, debug=True, half_precision=False,
+                  telemetry=True, data_mode="stream", producer_threads=1,
+                  ckpt_async=False, aot_warmup=True).replace(**overrides)
+
+
+class _Scraper(threading.Thread):
+    """Polls the live exporter while the run owns the main thread."""
+
+    def __init__(self, port: int):
+        super().__init__(name="gate-scraper", daemon=True)
+        self.port = port
+        self.stop = threading.Event()
+        self.metrics_ok = 0
+        self.last_metrics = ""
+        self.last_health = None
+
+    def run(self):
+        while not self.stop.is_set():
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{self.port}/metrics",
+                        timeout=2) as r:
+                    body = r.read().decode("utf-8")
+                if "dpt_up 1" in body:
+                    self.metrics_ok += 1
+                    self.last_metrics = body
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{self.port}/healthz",
+                        timeout=2) as r:
+                    self.last_health = json.loads(r.read().decode("utf-8"))
+            except (OSError, ValueError):
+                pass  # exporter not up yet / already down
+            self.stop.wait(0.2)
+
+
+def _prom_text_valid(body: str) -> bool:
+    """Every non-comment line must be "name[{labels}] value"."""
+    for line in body.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        parts = line.rsplit(" ", 1)
+        if len(parts) != 2:
+            return False
+        try:
+            float(parts[1])
+        except ValueError:
+            return False
+    return True
+
+
+def main() -> int:
+    from __graft_entry__ import _force_cpu_devices
+
+    _force_cpu_devices(1)
+
+    from distributedpytorch_tpu.cli import run_train
+
+    problems = []
+    work = tempfile.mkdtemp(prefix="goodput_gate_")
+
+    # -- stage A: attribution under injected badput, scraped live -----
+    rsl_a = os.path.join(work, "badput")
+    port = _free_port()
+    scraper = _Scraper(port)
+    scraper.start()
+    run_train(_base_cfg(rsl_a, fault_plan=PLAN, metrics_port=port))
+    scraper.stop.set()
+    scraper.join(timeout=10)
+
+    if scraper.metrics_ok == 0:
+        problems.append("no successful /metrics scrape during the run — "
+                        "the exporter never served")
+    else:
+        body = scraper.last_metrics
+        if not _prom_text_valid(body):
+            problems.append("/metrics body is not valid Prometheus "
+                            "text exposition")
+        for needle in ("dpt_goodput_seconds_total{category=\"compute\"}",
+                       "dpt_step_dispatch_s{quantile=\"0.5\"}",
+                       "dpt_up 1"):
+            if needle not in body:
+                problems.append(f"/metrics is missing {needle!r}")
+    health = scraper.last_health
+    if not health or health.get("rank") != 0 \
+            or health.get("status") != "ok":
+        problems.append(f"/healthz unusable during the run: {health}")
+
+    try:
+        with open(os.path.join(rsl_a, "goodput.json")) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        problems.append(f"no readable goodput.json ({e})")
+        doc = None
+    if doc:
+        wall, cats = doc["wall_s"], doc["categories"]
+        if doc["accounted_s"] < (1.0 - RESIDUAL_MAX) * wall:
+            problems.append(
+                f"ledger accounts {doc['accounted_s']:.2f}s of "
+                f"{wall:.2f}s wall — more than {RESIDUAL_MAX:.0%} "
+                f"leaked past the category hooks")
+        for row in doc["epochs"]:
+            got = sum(row["categories"].values())
+            if abs(got - row["wall_s"]) > max(0.01 * row["wall_s"], 1e-3):
+                problems.append(
+                    f"epoch {row['epoch']} row sums to {got:.3f}s vs "
+                    f"window {row['wall_s']:.3f}s — reconcile broke "
+                    f"the sums-to-wall invariant")
+        if cats.get("data_wait", 0.0) < 0.4:
+            problems.append(
+                f"data_wait={cats.get('data_wait', 0.0):.3f}s — the "
+                f"injected 0.5s stall was not attributed to data_wait")
+        if cats.get("retry_backoff", 0.0) < 0.02:
+            problems.append(
+                f"retry_backoff={cats.get('retry_backoff', 0.0):.3f}s "
+                f"— the ckpt.save retry sleeps were not attributed")
+        if cats.get("compute", 0.0) <= 0.0:
+            problems.append("compute category is empty — the step loop "
+                            "hook is not wired")
+        # non-overlap spot check: nothing exceeds wall clock
+        if sum(cats.values()) > wall * 1.01:
+            problems.append(
+                f"categories sum to {sum(cats.values()):.2f}s over "
+                f"{wall:.2f}s wall — something is double-counted")
+        print(f"goodput gate A: wall {wall:.2f}s, residual "
+              f"{100 * doc['residual_frac']:.2f}%, data_wait "
+              f"{cats.get('data_wait', 0):.2f}s, retry_backoff "
+              f"{cats.get('retry_backoff', 0):.3f}s, "
+              f"{scraper.metrics_ok} live scrape(s)")
+
+    # -- stage B: the offline artifact surfaces -----------------------
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    rep = subprocess.run([sys.executable, "main.py", "goodput",
+                          "--rsl_path", rsl_a], cwd=REPO, env=env,
+                         capture_output=True, text=True)
+    if rep.returncode != 0 or "top badput cause" not in rep.stdout:
+        problems.append(f"main.py goodput rc={rep.returncode}; output "
+                        f"missing the top-badput callout:\n"
+                        f"{rep.stdout[-800:]}\n{rep.stderr[-800:]}")
+    tl = subprocess.run([sys.executable, "main.py", "timeline",
+                         "--rsl_path", rsl_a], cwd=REPO, env=env,
+                        capture_output=True, text=True)
+    if tl.returncode != 0:
+        problems.append(f"main.py timeline rc={tl.returncode}:\n"
+                        f"{tl.stdout[-800:]}\n{tl.stderr[-800:]}")
+    else:
+        with open(os.path.join(rsl_a, "timeline.json")) as f:
+            trace = json.load(f)
+        gp_events = [e for e in trace["traceEvents"]
+                     if e.get("cat") == "goodput"]
+        if not any(e["ph"] == "X" for e in gp_events) \
+                or not any(e["ph"] == "C" for e in gp_events):
+            problems.append(
+                f"timeline has {len(gp_events)} goodput event(s) — "
+                f"expected both category slices (X) and the stacked "
+                f"counter series (C)")
+        else:
+            print(f"goodput gate B: summary + timeline track "
+                  f"({len(gp_events)} goodput trace events)")
+
+    # -- stage C: exporter overhead budget ----------------------------
+    def timed(rsl: str, metrics_port: int) -> float:
+        best = float("inf")
+        for _ in range(2):  # same rsl: run 2 reuses the compile cache
+            scr = _Scraper(metrics_port) if metrics_port else None
+            if scr:
+                scr.start()
+            t0 = time.perf_counter()
+            run_train(_base_cfg(rsl, metrics_port=metrics_port))
+            best = min(best, time.perf_counter() - t0)
+            if scr:
+                scr.stop.set()
+                scr.join(timeout=10)
+        return best
+
+    t_off = timed(os.path.join(work, "exp_off"), 0)
+    t_on = timed(os.path.join(work, "exp_on"), _free_port())
+    budget = t_off * (1.0 + OVERHEAD_REL) + OVERHEAD_ABS_S
+    if t_on > budget:
+        problems.append(
+            f"exporter overhead: on={t_on:.2f}s vs off={t_off:.2f}s "
+            f"exceeds the {OVERHEAD_REL:.0%}+{OVERHEAD_ABS_S}s budget "
+            f"({budget:.2f}s) — live monitoring is too expensive")
+    print(f"goodput gate C: exporter on={t_on:.2f}s off={t_off:.2f}s "
+          f"(budget {budget:.2f}s)")
+
+    for p in problems:
+        print(f"PROBLEM: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print("goodput gate OK: ledger sums to wall, badput attributed, "
+          "live /metrics served, overhead within budget")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -808,6 +808,89 @@ def test_collective_outside_cleanup_negative(tmp_path):
                  rule="collective-in-cleanup") == []
 
 
+# -- rule 13: wall-clock-in-measurement -------------------------------
+
+_WALL_BAD = """
+    import time
+
+    def measure(fn):
+        t0 = time.time()
+        fn()
+        return time.time() - t0
+"""
+
+_WALL_GOOD = """
+    import time
+
+    def measure(fn, rec):
+        t0 = time.perf_counter()
+        fn()
+        rec["ts"] = time.time()        # stamp only: the blessed use
+        rec["mono"] = time.monotonic()
+        return time.perf_counter() - t0
+"""
+
+
+def test_wall_clock_direct_and_tainted_positive(tmp_path):
+    found = _lint(tmp_path, {"meter.py": _WALL_BAD},
+                  rule="wall-clock-in-measurement")
+    # one finding for the subtraction line (direct call + tainted t0
+    # collapse to one finding per expression, not two)
+    assert len(found) == 1
+    assert "perf_counter" in found[0].message
+
+
+def test_wall_clock_stamp_only_negative(tmp_path):
+    assert _lint(tmp_path, {"meter.py": _WALL_GOOD},
+                 rule="wall-clock-in-measurement") == []
+
+
+def test_wall_clock_augassign_tainted_positive(tmp_path):
+    src = """
+        import time
+
+        def measure(fn):
+            start = time.time()
+            fn()
+            elapsed = 0.0
+            elapsed -= start
+            return elapsed
+    """
+    found = _lint(tmp_path, {"meter.py": src},
+                  rule="wall-clock-in-measurement")
+    assert len(found) == 1
+    assert "'start'" in found[0].message
+
+
+def test_wall_clock_scope_isolation_negative(tmp_path):
+    # a name tainted in one function is a different binding in another
+    src = """
+        import time
+
+        def stamp(rec):
+            t0 = time.time()
+            rec["ts"] = t0
+
+        def measure(fn, t0):
+            fn()
+            return time.perf_counter() - t0
+    """
+    assert _lint(tmp_path, {"meter.py": src},
+                 rule="wall-clock-in-measurement") == []
+
+
+def test_wall_clock_rationale_comment_silences(tmp_path):
+    src = """
+        import time
+
+        def skew(peer_wall):
+            # cross-host wall skew: wall clock IS the measurand here
+            return time.time() - peer_wall
+    """
+    assert _lint(tmp_path, {"meter.py": src},
+                 rule="wall-clock-in-measurement") == []
+
+
 # -- CLI contract ------------------------------------------------------
 
 def test_repo_lints_clean_via_run_cli(capsys):

@@ -325,3 +325,54 @@ def test_report_warns_on_write_errors_and_skipped_ranks():
     assert "WARNING: 3 telemetry write error(s)" in report
     # 2 processes ran, only rank 0's file was readable
     assert "rank(s) [1] skipped" in report
+
+
+# -- aggregation across an elastic reconfigure -------------------------
+
+
+def test_aggregate_across_elastic_reconfigure_counts_once():
+    """Two generations with different rank sets: counters sum exactly
+    once per event, every rank that ever wrote is listed, and the
+    shrunken world produces no spurious missing-rank WARNING."""
+    events = [{"kind": "event", "name": "run_start", "rank": r,
+               "ts": 1.0, "attrs": {"processes": 3}} for r in range(3)]
+    # generation 0: three ranks count batches
+    events += [{"kind": "counter", "name": "data/batches", "rank": r,
+                "ts": 2.0, "value": 10.0} for r in range(3)]
+    # rank 2 dies; the survivors re-rendezvous as a 2-world and say so
+    events += [{"kind": "event", "name": "elastic/reconfigure",
+                "rank": r, "ts": 3.0,
+                "attrs": {"generation": 1, "old_world": 3,
+                          "new_world": 2, "old_rank": r, "new_rank": r}}
+               for r in range(2)]
+    # generation 1: the survivors keep counting
+    events += [{"kind": "counter", "name": "data/batches", "rank": r,
+                "ts": 4.0, "value": 5.0} for r in range(2)]
+    agg = telemetry.aggregate(events)
+    assert agg["ranks"] == [0, 1, 2]
+    assert agg["counters"]["data/batches"] == pytest.approx(40.0)
+    report = telemetry.render_report(agg)
+    # every rank's file is readable here — nothing to warn about
+    assert "skipped (telemetry writer" not in report
+
+
+def test_report_notes_departed_rank_instead_of_warning():
+    """The departed rank's file never landed: with a reconfigure event
+    in evidence that is expected elastic behavior (a note), while a
+    missing rank INSIDE the surviving world stays a real WARNING."""
+    base = [{"kind": "event", "name": "run_start", "rank": 0, "ts": 1.0,
+             "attrs": {"processes": 3}},
+            {"kind": "event", "name": "elastic/reconfigure", "rank": 0,
+             "ts": 2.0, "attrs": {"generation": 1, "old_world": 3,
+                                  "new_world": 2, "old_rank": 0,
+                                  "new_rank": 0}}]
+    # rank 1 present, rank 2 (departed) absent: note, no WARNING
+    report = telemetry.render_report(telemetry.aggregate(
+        base + [{"kind": "counter", "name": "data/batches", "rank": 1,
+                 "ts": 2.5, "value": 1.0}]))
+    assert "rank(s) [2] departed in an elastic reconfigure" in report
+    assert "skipped (telemetry writer" not in report
+    # rank 1 (a survivor slot) ALSO missing: that one is a lost writer
+    report = telemetry.render_report(telemetry.aggregate(base))
+    assert "rank(s) [2] departed in an elastic reconfigure" in report
+    assert "rank(s) [1] skipped" in report
