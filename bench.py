@@ -61,6 +61,68 @@ def peak_flops(device_kind: str, dtype: str = "bf16") -> float | None:
     return _pf(device_kind, dtype)
 
 
+def provenance_block(fresh: bool = True, probe_device: bool = True) -> dict:
+    """The provenance stamp every bench artifact carries (ISSUE 12):
+    `fresh` (measured in THIS process vs replayed), device, wall-clock
+    timestamp, and the tree's git sha — so a stale artifact can't
+    masquerade as current.  profile_breakdown.py reuses this block
+    verbatim; scripts/check_bench.py gates on the `fresh` flag.
+
+    ``probe_device=False`` skips touching the JAX backend — the
+    backend-down fallback path must not re-risk the hang it is
+    falling back from."""
+    block: dict = {"fresh": bool(fresh),
+                   "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+                   "git_sha": None, "device_kind": None}
+    try:
+        r = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10)
+        block["git_sha"] = r.stdout.strip() or None
+    except Exception:  # no git / not a checkout: provenance degrades
+        pass
+    if probe_device:
+        try:
+            import jax
+
+            block["device_kind"] = jax.devices()[0].device_kind
+        except Exception:  # provenance is advisory; a dead backend
+            pass           # must not fail the headline row
+    return block
+
+
+def _top_ops_roofline(compiled_short, run_short, device_kind,
+                      program: str = "train_epoch") -> list:
+    """Trace ONE short execution and return roofline top-3 ops.
+
+    Runs strictly AFTER the timed measurement (profiling alters
+    dispatch behavior, and the D2H cliff has already been paid by the
+    FLOPs accounting).  The HLO text of the short program feeds the
+    analytic per-op join directly — no costs.json round trip."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from distributedpytorch_tpu import roofline
+
+    td = tempfile.mkdtemp(prefix="bench_roofline_")
+    try:
+        jax.profiler.start_trace(td)
+        try:
+            run_short()
+        finally:
+            jax.profiler.stop_trace()
+        costs_data = {"device_kind": device_kind,
+                      "programs": {program: {"hlo": compiled_short.as_text()}}}
+        rep = roofline.analyze(td, costs_data=costs_data,
+                               device_kind=device_kind)
+        return roofline.top_ops(rep, 3)
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+
+
 def _force_sync_timing_mode() -> None:
     """Pin the device runtime into its SYNCHRONOUS dispatch mode before
     any timed run (round-4 characterization of this environment's
@@ -223,6 +285,32 @@ def bench_ours(batch_per_replica: int, steps: int, model_name: str,
     out["achieved_tflops"] = achieved / 1e12 / n_chips
     if peak is not None:
         out["mfu"] = achieved / (peak * n_chips)
+    # Top-3 ops by self time with their bound class (ISSUE 12): the
+    # explanation layer for BENCH deltas.  A separate SHORT plan is
+    # compiled and traced once — tracing the 12-epoch fused dispatch
+    # would produce a gigabyte trace for the same ranking.  Advisory:
+    # any failure leaves the row without top_ops, never without a
+    # measurement.
+    try:
+        k = min(8, n_steps)
+        sidx, svalid = idx[:k], valid[:k]
+        compiled_short = engine.train_epoch.lower(
+            state, loader.images, loader.labels, sidx, svalid,
+            key).compile()
+
+        def run_short():
+            _state, metrics = compiled_short(
+                state, loader.images, loader.labels, sidx, svalid, key)
+            jax.block_until_ready(metrics["loss"])
+
+        out["top_ops"] = _top_ops_roofline(compiled_short, run_short,
+                                           device_kind)
+        log("top ops by self time: " + ", ".join(
+            f"{t['name']} {t['time_share'] * 100:.0f}% ({t['bound']})"
+            for t in out["top_ops"]))
+    except Exception as e:  # advisory enrichment: a profiler or HLO
+        # parse failure must never fail the timed bench itself
+        log(f"top-ops roofline skipped ({e})")
     log(f"steady state: {n_steps} steps x {global_batch} global batch "
         f"in {elapsed:.3f}s -> {sps:,.0f} samples/s "
         f"({sps / n_chips:,.0f}/chip)"
@@ -831,7 +919,9 @@ def _fallback_headline() -> dict | None:
                 # Machine-readable provenance (VERDICT r5 weak #1):
                 # consumers gate on this flag, not the error prose.  A
                 # replayed measurement must NEVER carry vs_baseline.
-                "fresh": False,
+                # probe_device=False: this path exists because the
+                # backend is down — don't re-risk the hang.
+                **provenance_block(fresh=False, probe_device=False),
                 "vs_baseline": None,
                 "mfu": (round(row["mfu"], 4) if row.get("mfu")
                         else None),
@@ -948,13 +1038,14 @@ def main() -> int:
         "metric": "mnist_cnn_train_samples_per_sec_per_chip",
         "value": round(value, 1),
         "unit": "samples/s/chip",
-        # provenance flag (VERDICT r5 weak #1): this row was MEASURED in
-        # this process; replayed fallbacks carry fresh=false and a null
-        # vs_baseline (scripts/check_bench.py gates on it)
-        "fresh": True,
+        # provenance block (VERDICT r5 weak #1 + ISSUE 12): this row was
+        # MEASURED in this process; replayed fallbacks carry fresh=false
+        # and a null vs_baseline (scripts/check_bench.py gates on it)
+        **provenance_block(fresh=True),
         "vs_baseline": round(vs, 2) if vs is not None else None,
         "mfu": (round(ours["mfu"], 4) if ours.get("mfu") else None),
         "mfu_peak_dtype": ours.get("mfu_peak_dtype"),
+        "top_ops": ours.get("top_ops"),
     }), flush=True)
     return 0
 

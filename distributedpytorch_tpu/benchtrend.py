@@ -1,0 +1,245 @@
+"""Bench-trend regression ledger over the committed BENCH history
+(ISSUE 12 satellite).
+
+``BENCH_r*.json`` is the repo's perf trajectory — one headline row per
+driver round — and ``BENCH_SUITE.json`` the latest per-model sweep.
+This module turns them into a machine-checkable trend: samples/s/chip
+and MFU per round, with deltas computed ONLY between provenance-clean
+rows (``fresh: true``, or pre-flag legacy rows without an ``error`` —
+the exact tolerance scripts/check_bench.py codified).  Replayed rounds
+(``fresh: false``, e.g. the TPU tunnel was down) are SHOWN but never
+used as a delta endpoint: a stale number differenced against a fresh
+one is not a regression, it is provenance noise.
+
+The verdict gates on the LATEST eligible delta only.  Historical
+rounds legitimately regressed (r01->r02 was -5.1% and was accepted at
+the time); a CI gate that re-litigates history would be permanently
+red, so the gate asks the only actionable question: did the newest
+fresh measurement regress against the previous fresh one?
+
+Exit contract (scripts/bench_trend.py, ``main.py bench-trend``):
+exit 0 = no regression beyond the threshold, 1 = regression.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+SCHEMA = 1
+DEFAULT_THRESHOLD = 0.05
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def headline_row(doc: Any) -> Optional[dict]:
+    """The bench headline inside a BENCH file: either the row itself or
+    the last JSON-looking line of a driver round file's log tail (same
+    rule as scripts/check_bench.py)."""
+    if isinstance(doc, dict) and "metric" in doc:
+        return doc
+    if isinstance(doc, dict) and isinstance(doc.get("tail"), str):
+        for line in reversed(doc["tail"].strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    return None
+                return row if isinstance(row, dict) else None
+    return None
+
+
+def delta_eligible(row: dict) -> bool:
+    """May this row serve as a delta endpoint?
+
+    ``fresh: true`` rows qualify; rows explicitly flagged ``fresh:
+    false`` never do; legacy rows (written before the flag existed)
+    qualify unless they carry an ``error`` — mirroring check_bench.py's
+    tolerance, which keeps rounds 1-4 in the trajectory while excluding
+    the round-5 replay that predates the flag."""
+    if "fresh" in row:
+        return row["fresh"] is True
+    return not row.get("error")
+
+
+def load_rounds(bench_dir: Optional[str] = None
+                ) -> List[Tuple[int, str, Optional[dict]]]:
+    """All ``BENCH_r*.json`` as (round_number, filename, headline_row),
+    sorted by round.  Unparseable files yield a None row (reported,
+    never fatal)."""
+    root = bench_dir or repo_root()
+    out = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            row = headline_row(doc)
+        except (OSError, ValueError):
+            row = None
+        out.append((int(m.group(1)), os.path.basename(path), row))
+    out.sort()
+    return out
+
+
+def load_suite(bench_dir: Optional[str] = None) -> Dict[str, dict]:
+    """Per-model rows of BENCH_SUITE.json (empty when absent)."""
+    root = bench_dir or repo_root()
+    try:
+        with open(os.path.join(root, "BENCH_SUITE.json")) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    suite = doc.get("suite")
+    return suite if isinstance(suite, dict) else {}
+
+
+def _metric_series(rounds, key: str) -> List[Optional[float]]:
+    out = []
+    for _n, _fn, row in rounds:
+        v = row.get(key) if row else None
+        out.append(float(v) if isinstance(v, (int, float)) else None)
+    return out
+
+
+def build_trend(bench_dir: Optional[str] = None,
+                threshold: float = DEFAULT_THRESHOLD) -> Dict[str, Any]:
+    """The full trend report + verdict.  Raises ValueError when there
+    is no BENCH history at all (nothing to trend)."""
+    rounds = load_rounds(bench_dir)
+    if not rounds:
+        raise ValueError(
+            f"no BENCH_r*.json under {bench_dir or repo_root()!r}; "
+            f"run the bench driver first")
+    values = _metric_series(rounds, "value")
+    mfus = _metric_series(rounds, "mfu")
+    rows: List[Dict[str, Any]] = []
+    prev_eligible: Optional[int] = None
+    for i, (n, fn, row) in enumerate(rounds):
+        eligible = bool(row) and delta_eligible(row) \
+            and values[i] is not None
+        entry: Dict[str, Any] = {
+            "round": n, "file": fn,
+            "value": values[i], "mfu": mfus[i],
+            "fresh": (row.get("fresh") if row and "fresh" in row
+                      else None),
+            "replay": bool(row.get("error")) if row else None,
+            "eligible": eligible,
+            "delta": None, "mfu_delta": None,
+        }
+        if row is None:
+            entry["note"] = "unreadable or headline-less file"
+        elif not eligible:
+            entry["note"] = ("replayed measurement — shown, excluded "
+                             "from deltas")
+        if eligible:
+            if prev_eligible is not None:
+                pv, pm = values[prev_eligible], mfus[prev_eligible]
+                if pv:
+                    entry["delta"] = values[i] / pv - 1.0
+                if pm and mfus[i] is not None:
+                    entry["mfu_delta"] = mfus[i] / pm - 1.0
+            prev_eligible = i
+        rows.append(entry)
+    eligible_rows = [r for r in rows if r["eligible"]]
+    latest_delta = next((r["delta"] for r in reversed(rows)
+                         if r["delta"] is not None), None)
+    latest_mfu_delta = next((r["mfu_delta"] for r in reversed(rows)
+                             if r["mfu_delta"] is not None), None)
+    regression = latest_delta is not None and latest_delta < -threshold
+    notes: List[str] = []
+    if len(eligible_rows) < 2:
+        notes.append(f"only {len(eligible_rows)} delta-eligible "
+                     f"round(s) — no trend to gate yet")
+    suite = load_suite(bench_dir)
+    suite_out = {}
+    for name, row in sorted(suite.items()):
+        if not isinstance(row, dict):
+            continue
+        suite_out[name] = {
+            "samples_per_sec_per_chip":
+                row.get("samples_per_sec_per_chip"),
+            "mfu": row.get("mfu"),
+            "top_ops": row.get("top_ops"),
+        }
+    return {
+        "schema": SCHEMA,
+        "metric": "mnist_cnn_train_samples_per_sec_per_chip",
+        "threshold": threshold,
+        "rounds": rows,
+        "n_eligible": len(eligible_rows),
+        "latest_delta": latest_delta,
+        "latest_mfu_delta": latest_mfu_delta,
+        "regression": regression,
+        "ok": not regression,
+        "suite": suite_out,
+        "notes": notes,
+    }
+
+
+def render_trend(trend: Dict[str, Any]) -> str:
+    lines = ["== bench trend =="]
+    lines.append(f"headline metric: {trend['metric']} "
+                 f"(threshold {trend['threshold'] * 100:.1f}%)")
+    lines.append(f"  {'round':>5} {'samples/s/chip':>15} {'MFU':>7} "
+                 f"{'fresh':>6} {'delta':>8}")
+    for r in trend["rounds"]:
+        v = f"{r['value']:,.1f}" if r["value"] is not None else "-"
+        m = f"{r['mfu'] * 100:.2f}%" if r["mfu"] is not None else "-"
+        fresh = {True: "yes", False: "NO", None: "n/a"}[r["fresh"]]
+        if r["delta"] is not None:
+            d = f"{r['delta'] * 100:+.1f}%"
+        elif not r["eligible"]:
+            d = "excl"
+        else:
+            d = "-"
+        lines.append(f"  {r['round']:>5} {v:>15} {m:>7} {fresh:>6} "
+                     f"{d:>8}")
+    if trend["latest_delta"] is not None:
+        lines.append(
+            f"latest fresh-vs-fresh delta: "
+            f"{trend['latest_delta'] * 100:+.2f}% samples/s"
+            + (f", {trend['latest_mfu_delta'] * 100:+.2f}% MFU"
+               if trend["latest_mfu_delta"] is not None else ""))
+    for n in trend["notes"]:
+        lines.append(f"note: {n}")
+    if trend["suite"]:
+        lines.append("suite snapshot (BENCH_SUITE.json):")
+        for name, row in trend["suite"].items():
+            sps = row["samples_per_sec_per_chip"]
+            sps_s = f"{sps:,.1f}/chip" if sps is not None else "-"
+            mfu_s = f"MFU {row['mfu'] * 100:.1f}%" \
+                if row.get("mfu") is not None else "MFU -"
+            tops = row.get("top_ops") or []
+            top_s = ("; top: " + ", ".join(
+                f"{t['name']} ({t['bound']})" for t in tops[:3]
+                if isinstance(t, dict))) if tops else ""
+            lines.append(f"  {name:<22} {sps_s:>15}  {mfu_s}{top_s}")
+    lines.append("verdict: " + ("OK — no regression beyond threshold"
+                                if trend["ok"] else
+                                f"REGRESSION — latest delta "
+                                f"{trend['latest_delta'] * 100:+.2f}% "
+                                f"exceeds -{trend['threshold'] * 100:.1f}%"))
+    return "\n".join(lines)
+
+
+def run_cli(bench_dir: Optional[str] = None,
+            threshold: float = DEFAULT_THRESHOLD,
+            as_json: bool = False) -> Tuple[bool, str]:
+    """(ok, printable output) for ``main.py bench-trend`` and
+    scripts/bench_trend.py; callers exit 1 when ok is False."""
+    trend = build_trend(bench_dir, threshold=threshold)
+    if as_json:
+        return trend["ok"], json.dumps(trend, indent=2, sort_keys=True,
+                                       default=float)
+    return trend["ok"], render_trend(trend)

@@ -276,26 +276,26 @@ def _aot_warmup(cfg: Config, engine: Engine, state, train_loader,
         costs.record("train_epochs", engine.train_epochs.lower(
             state, train_loader.images, train_loader.labels, idx_tr,
             valid_tr, valid_loader.images, valid_loader.labels,
-            idx_va, valid_va, keys).compile())
+            idx_va, valid_va, keys).compile(), hlo=True)
     else:
         if isinstance(train_loader, ResidentLoader):
             idx_tr, valid_tr = plan(train_loader)
             costs.record("train_epoch", engine.train_epoch.lower(
                 state, train_loader.images, train_loader.labels, idx_tr,
-                valid_tr, key).compile())
+                valid_tr, key).compile(), hlo=True)
         else:
             img, lbl, vld = batch(train_loader)
             costs.record("train_step", engine.train_step.lower(
-                state, img, lbl, vld, key).compile())
+                state, img, lbl, vld, key).compile(), hlo=True)
         if isinstance(valid_loader, ResidentLoader):
             idx_va, valid_va = plan(valid_loader)
             costs.record("eval_epoch", engine.eval_epoch.lower(
                 state, valid_loader.images, valid_loader.labels, idx_va,
-                valid_va).compile())
+                valid_va).compile(), hlo=True)
         else:
             img, lbl, vld = batch(valid_loader)
             costs.record("eval_step", engine.eval_step.lower(
-                state, img, lbl, vld).compile())
+                state, img, lbl, vld).compile(), hlo=True)
     warmup_s = time.perf_counter() - t0
     goodput.get().add("compile", warmup_s)
     hit = runtime.compilation_cache_hits() > hits_before
@@ -1132,6 +1132,30 @@ def _run_train_epochs(cfg: Config, engine: Engine, state, train_loader,
                     if runtime.is_main():
                         logging.info(f"profiler trace written to "
                                      f"{cfg.rsl_path}/trace")
+                        # Auto-attribute the fresh trace: a --profile
+                        # run leaves roofline.json + a 'roofline'
+                        # telemetry event beside the raw capture, so
+                        # op-level blame never requires a second
+                        # command.  Advisory: analysis failure must not
+                        # fail the epoch.
+                        try:
+                            from . import roofline
+
+                            rep = roofline.analyze(
+                                f"{cfg.rsl_path}/trace",
+                                rsl_path=cfg.rsl_path)
+                            roofline.save_report(rep, cfg.rsl_path)
+                            roofline.emit_telemetry(rep, tel)
+                            logging.info(
+                                f"roofline: {rep['coverage'] * 100:.1f}%"
+                                f" of step time attributed to "
+                                f"{rep['n_ops']} ops (top: "
+                                f"{rep['ops'][0]['name']})")
+                        except Exception as e:
+                            # advisory post-run analysis: a torn trace
+                            # or parse bug must never fail the run
+                            logging.warning(
+                                f"roofline analysis skipped: {e}")
 
             end = utils.monotonic()
             epoch_mins, epoch_secs = utils.get_duration(epoch_start, end)
@@ -1302,7 +1326,8 @@ def main(argv=None) -> int:
         # Offline aggregation of RSL_PATH/telemetry/rank*.jsonl — no
         # training banners, no JAX backend touched.
         try:
-            print(telemetry.report(cfg.rsl_path))
+            print(telemetry.json_report(cfg.rsl_path)
+                  if cfg.report_json else telemetry.report(cfg.rsl_path))
         except ValueError as e:
             logging.error(f"{e}, exiting...")
             return 1
@@ -1316,6 +1341,36 @@ def main(argv=None) -> int:
             logging.error(f"{e}, exiting...")
             return 1
         return 0
+    if cfg.action == "roofline":
+        # Offline per-op roofline attribution of a profiler trace — no
+        # JAX backend touched (the analysis reads trace JSON + the HLO
+        # text costs.json saved at compile time).
+        from . import roofline
+
+        try:
+            print(roofline.run_cli(
+                cfg.rsl_path, trace_dir=cfg.roofline_trace_dir,
+                from_anomaly=cfg.roofline_from_anomaly,
+                top=cfg.roofline_top, as_json=cfg.report_json))
+        except ValueError as e:
+            logging.error(f"{e}, exiting...")
+            return 1
+        return 0
+    if cfg.action == "bench-trend":
+        # Regression ledger over the checked-in BENCH history; the
+        # verdict gates CI (exit 1 on a fresh-vs-fresh regression).
+        from . import benchtrend
+
+        try:
+            verdict, text = benchtrend.run_cli(
+                bench_dir=cfg.trend_dir,
+                threshold=cfg.trend_threshold,
+                as_json=cfg.report_json)
+        except ValueError as e:
+            logging.error(f"{e}, exiting...")
+            return 1
+        print(text)
+        return 0 if verdict else 1
     print("========================= start =========================")
     rc = 0
     try:

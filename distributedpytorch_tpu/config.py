@@ -240,6 +240,20 @@ class Config:
     # 'timeline' subcommand: merged Chrome trace-event output path
     # (default RSL_PATH/timeline.json).
     timeline_out: Optional[str] = None
+    # 'roofline' subcommand (roofline.py): per-op trace attribution.
+    # trace_dir overrides the RSL_PATH/trace default; from_anomaly
+    # analyzes the newest anomaly capture instead.
+    roofline_trace_dir: Optional[str] = None
+    roofline_from_anomaly: bool = False
+    roofline_top: int = 20
+    # 'bench-trend' subcommand (benchtrend.py): regression ledger over
+    # BENCH_r*.json; exit 1 when the latest fresh-vs-fresh delta drops
+    # more than trend_threshold (fractional).
+    trend_dir: Optional[str] = None
+    trend_threshold: float = 0.05
+    # Machine-readable output for the offline report subcommands
+    # (telemetry/roofline/bench-trend --json).
+    report_json: bool = False
     # Live monitoring: serve Prometheus text at
     # http://0.0.0.0:(metrics_port + rank)/metrics (and /healthz) for the
     # life of the run.  0 disables the exporter.
@@ -567,6 +581,9 @@ def build_parser() -> argparse.ArgumentParser:
     p_rep.add_argument("--rsl_path", type=str, default=RSL_PATH,
                        help=f"run directory holding telemetry/ "
                             f"(default: {RSL_PATH})")
+    p_rep.add_argument("--json", action="store_true",
+                       help="machine-readable aggregate output (the "
+                            "same dict render_report formats)")
 
     # Offline goodput summary — reads RSL_PATH/goodput*.json written by
     # a run with --telemetry or --metrics-port; no train/test flags.
@@ -593,6 +610,47 @@ def build_parser() -> argparse.ArgumentParser:
                       help="trace output path (default: "
                            "RSL_PATH/timeline.json)")
 
+    # Offline roofline attribution — reads a jax.profiler trace dir
+    # (RSL_PATH/trace from --profile, or an anomaly capture) plus
+    # RSL_PATH/costs.json, writes RSL_PATH/roofline.json; no train/test
+    # flags and no device work.
+    p_rl = sub.add_parser(
+        "roofline", help="per-op roofline attribution of a profiler "
+                         "trace: time share, compute- vs memory-bound, "
+                         "achieved-vs-roofline utilization")
+    p_rl.add_argument("--rsl_path", type=str, default=RSL_PATH,
+                      help=f"run directory holding trace/ and "
+                           f"costs.json (default: {RSL_PATH})")
+    p_rl.add_argument("--trace-dir", type=str, default=None,
+                      metavar="DIR",
+                      help="analyze this jax.profiler capture instead "
+                           "of RSL_PATH/trace")
+    p_rl.add_argument("--from-anomaly", action="store_true",
+                      help="analyze the newest anomaly capture under "
+                           "RSL_PATH/anomaly_traces/ instead")
+    p_rl.add_argument("--top", type=int, default=20,
+                      help="rows in the ranked table (default 20)")
+    p_rl.add_argument("--json", action="store_true",
+                      help="print the full roofline.json report "
+                           "instead of the table")
+
+    # Bench regression ledger — reads the checked-in BENCH_r*.json /
+    # BENCH_SUITE.json history; exit 1 on a regression beyond the
+    # threshold (see scripts/bench_trend.py).
+    p_bt = sub.add_parser(
+        "bench-trend", help="samples/s + MFU trajectory over the BENCH "
+                            "history; deltas only between fresh rows; "
+                            "exit 1 on regression")
+    p_bt.add_argument("--dir", type=str, default=None, metavar="DIR",
+                      help="directory holding BENCH_r*.json (default: "
+                           "repo root)")
+    p_bt.add_argument("--threshold", type=float, default=0.05,
+                      help="fractional drop in the latest fresh-vs-"
+                           "fresh delta that fails the run "
+                           "(default 0.05)")
+    p_bt.add_argument("--json", action="store_true",
+                      help="machine-readable verdict output")
+
     # Static analysis (analysis/ graftlint) — no JAX backend touched.
     p_lint = sub.add_parser(
         "lint", help="run the graftlint static analysis pass "
@@ -607,12 +665,22 @@ def build_parser() -> argparse.ArgumentParser:
 def config_from_argv(argv=None) -> Config:
     args = build_parser().parse_args(argv)
     if args.action == "telemetry":
-        return Config(action="telemetry", rsl_path=args.rsl_path)
+        return Config(action="telemetry", rsl_path=args.rsl_path,
+                      report_json=args.json)
     if args.action == "goodput":
         return Config(action="goodput", rsl_path=args.rsl_path)
     if args.action == "timeline":
         return Config(action="timeline", rsl_path=args.rsl_path,
                       timeline_out=args.out)
+    if args.action == "roofline":
+        return Config(action="roofline", rsl_path=args.rsl_path,
+                      roofline_trace_dir=args.trace_dir,
+                      roofline_from_anomaly=args.from_anomaly,
+                      roofline_top=args.top, report_json=args.json)
+    if args.action == "bench-trend":
+        return Config(action="bench-trend", trend_dir=args.dir,
+                      trend_threshold=args.threshold,
+                      report_json=args.json)
     if args.action == "lint":
         return Config(action="lint", lint_json=args.json,
                       lint_paths=tuple(args.paths))

@@ -30,9 +30,10 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 
@@ -40,6 +41,11 @@ from . import telemetry
 
 _lock = threading.Lock()
 _registry: Dict[str, dict] = {}
+
+# Optimized-HLO text larger than this is not persisted into costs.json
+# (a costs file is provenance, not an artifact dump); the per-op
+# roofline join then degrades to name heuristics for that program.
+_HLO_TEXT_CAP = 4 * 1024 * 1024
 
 
 def reset() -> None:
@@ -83,13 +89,19 @@ def _stamp(entry: dict) -> dict:
     return entry
 
 
-def record(name: str, compiled: Any) -> dict:
+def record(name: str, compiled: Any, hlo: bool = False) -> dict:
     """Register an AOT-compiled executable's XLA cost estimate.
 
     ``flops``/``bytes_accessed`` are per *invocation* of the program (so
     an epoch-fused program reports the whole epoch's FLOPs, a step
     program one step's).  Missing metrics record as None — an explicit
     "the backend would not say", never a silent zero.
+
+    With ``hlo=True`` the optimized HLO text (``compiled.as_text()``) is
+    kept alongside, bounded by ``_HLO_TEXT_CAP``: it is what lets the
+    roofline analyzer (roofline.py) join a profiler trace's per-op
+    events against analytic per-op FLOPs/bytes (``hlo_op_costs``)
+    when the trace itself carries no cost metadata.
     """
     ca = _first_analysis(compiled)
 
@@ -107,6 +119,13 @@ def record(name: str, compiled: Any) -> dict:
         "flops": _metric("flops"),
         "bytes_accessed": _metric("bytes accessed"),
     })
+    if hlo:
+        try:
+            text = compiled.as_text()
+        except Exception:
+            text = None  # HLO text is advisory, like cost_analysis
+        if isinstance(text, str) and 0 < len(text) <= _HLO_TEXT_CAP:
+            entry["hlo"] = text
     with _lock:
         _registry[name] = entry
     telemetry.get().event("cost_analysis", program=name,
@@ -196,3 +215,204 @@ def load(rsl_path: str) -> Optional[dict]:
             return json.load(f)
     except (OSError, ValueError):
         return None
+
+
+# -- per-op analytic costs from optimized HLO text ---------------------
+#
+# XLA's cost_analysis() speaks per PROGRAM; a profiler trace speaks per
+# OP (instruction name in ``args.hlo_op``).  The bridge is the optimized
+# HLO text: instruction names there are exactly the trace's op names
+# (module-unique by XLA construction), and shapes + opcodes are enough
+# for analytic FLOPs/bytes per execution of each instruction.  The
+# counting conventions mirror ops/flops.py: matmul/conv at 2*MACs,
+# elementwise at one FLOP per output element, reductions at one per
+# input element, data movement at zero; bytes are the operand + result
+# footprint of the instruction itself (a fusion's interior traffic stays
+# on-chip, which is precisely what makes fusion a roofline win).
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+                "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "f32": 4,
+                "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16}
+
+# One output-element FLOP each; everything arithmetic that XLA leaves
+# unfused.  Transcendentals cost more microscopically but never matter
+# at roofline granularity.
+_ELEMENTWISE = {
+    "add", "subtract", "multiply", "divide", "negate", "abs", "sign",
+    "maximum", "minimum", "power", "remainder", "exponential", "log",
+    "log-plus-one", "exponential-minus-one", "tanh", "logistic", "sqrt",
+    "rsqrt", "cbrt", "sine", "cosine", "tan", "atan2", "floor", "ceil",
+    "round-nearest-afz", "round-nearest-even", "compare", "select",
+    "clamp", "and", "or", "xor", "not", "shift-left",
+    "shift-right-arithmetic", "shift-right-logical", "convert",
+    "is-finite", "erf",
+}
+_REDUCTIONS = {"reduce", "reduce-window", "select-and-scatter"}
+
+_SHAPE_RE = re.compile(r"\b([a-z]+\d*(?:e\d+m\d+\w*)?)\[([\d,]*)\]")
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%([^\s=]+)\s*=\s*(\(?.*?\)?)\s+([a-z][\w-]*)\(")
+_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%([^\s(]+)\s*(?:\(.*)?\{\s*$")
+
+
+def _shape_list(text: str) -> List[Tuple[str, int]]:
+    """Every ``dtype[dims]`` in ``text`` as (dtype, element_count)."""
+    out = []
+    for dtype, dims in _SHAPE_RE.findall(text):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        out.append((dtype, n))
+    return out
+
+
+def _shapes_bytes(shapes: List[Tuple[str, int]]) -> float:
+    return float(sum(n * _DTYPE_BYTES.get(dt, 4) for dt, n in shapes))
+
+
+def _operand_span(line: str, opcode: str) -> str:
+    """The operand list of an instruction line: the balanced paren group
+    right after the opcode (attrs follow the closing paren)."""
+    i = line.find(opcode + "(")
+    if i < 0:
+        return ""
+    i += len(opcode)
+    depth = 0
+    for j in range(i, len(line)):
+        if line[j] == "(":
+            depth += 1
+        elif line[j] == ")":
+            depth -= 1
+            if depth == 0:
+                return line[i + 1:j]
+    return line[i + 1:]
+
+
+def _dims_of(shape_text: str) -> List[int]:
+    m = _SHAPE_RE.search(shape_text)
+    if not m:
+        return []
+    return [int(d) for d in m.group(2).split(",") if d]
+
+
+def _dot_flops(line: str, result_elems: float, operands: str) -> float:
+    """2 * output elements * contraction size, contraction dims read
+    from the lhs_contracting_dims attribute against the lhs shape."""
+    op_shapes = _SHAPE_RE.findall(operands)
+    lhs_dims = []
+    if op_shapes:
+        lhs_dims = [int(d) for d in op_shapes[0][1].split(",") if d]
+    m = re.search(r"lhs_contracting_dims=\{([\d,]*)\}", line)
+    k = 1
+    if m and lhs_dims:
+        for idx in m.group(1).split(","):
+            if idx and int(idx) < len(lhs_dims):
+                k *= lhs_dims[int(idx)]
+    elif lhs_dims:
+        k = lhs_dims[-1]  # degraded: assume last-dim contraction
+    return 2.0 * result_elems * k
+
+
+def _conv_flops(line: str, result_elems: float, operands: str) -> float:
+    """2 * output elements * kernel-spatial * kernel-input-features,
+    kernel dim roles read from the dim_labels attribute."""
+    op_shapes = _SHAPE_RE.findall(operands)
+    if len(op_shapes) < 2:
+        return 0.0
+    kdims = [int(d) for d in op_shapes[1][1].split(",") if d]
+    m = re.search(r"dim_labels=[^_\s,]+_([^-\s,]+)->", line)
+    if m and len(m.group(1)) == len(kdims):
+        spec = m.group(1)
+        k = 1.0
+        for pos, ch in enumerate(spec):
+            if ch == "i" or ch.isdigit():
+                k *= kdims[pos]
+        return 2.0 * result_elems * k
+    # Degraded: whole kernel divided by its (unknown-position) output
+    # features — drop the largest dim as the best "o" guess.
+    prod = 1.0
+    for d in kdims:
+        prod *= d
+    return 2.0 * result_elems * prod / max(kdims, default=1)
+
+
+def hlo_op_costs(hlo_text: str) -> Dict[str, dict]:
+    """Analytic per-op {flops, bytes, opcode, dtype} from optimized HLO.
+
+    Keys are instruction names exactly as a profiler trace's
+    ``args.hlo_op`` reports them.  FLOPs/bytes are per single execution
+    of the instruction (a trace event is one execution, so
+    achieved-rate math multiplies by the observed event count).  Fusions
+    sum the FLOPs of their called computation but count only their own
+    operand/result bytes.  Anything unparseable degrades to an absent
+    key, never an exception — the roofline join then classifies that op
+    by name heuristic and says so.
+    """
+    comps: Dict[str, List[tuple]] = {}
+    current: Optional[str] = None
+    for raw in hlo_text.splitlines():
+        line = raw.rstrip()
+        if current is None:
+            m = _COMP_RE.match(line.strip())
+            if m and line.rstrip().endswith("{"):
+                current = m.group(1)
+                comps[current] = []
+            continue
+        if line.strip() == "}":
+            current = None
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, result_text, opcode = m.groups()
+        comps[current].append((name, opcode, result_text, line))
+
+    def _instr_flops(opcode: str, result_text: str, line: str,
+                     seen: frozenset) -> float:
+        result_elems = float(sum(n for _, n in _shape_list(result_text)))
+        operands = _operand_span(line, opcode)
+        if opcode == "dot":
+            return _dot_flops(line, result_elems, operands)
+        if opcode == "convolution":
+            return _conv_flops(line, result_elems, operands)
+        if opcode == "fusion":
+            m = re.search(r"calls=%([^\s,)]+)", line)
+            if m:
+                return _comp_flops(m.group(1), seen)
+            return 0.0
+        if opcode in _REDUCTIONS:
+            shapes = _shape_list(operands)
+            return float(shapes[0][1]) if shapes else 0.0
+        if opcode in _ELEMENTWISE:
+            return result_elems
+        return 0.0
+
+    def _comp_flops(comp: str, seen: frozenset) -> float:
+        if comp in seen:  # malformed/recursive text: refuse the cycle
+            return 0.0
+        total = 0.0
+        for _name, opcode, result_text, line in comps.get(comp, []):
+            total += _instr_flops(opcode, result_text, line,
+                                  seen | {comp})
+        return total
+
+    out: Dict[str, dict] = {}
+    for comp, instrs in comps.items():
+        for name, opcode, result_text, line in instrs:
+            if opcode in ("parameter", "constant", "tuple",
+                          "get-tuple-element"):
+                continue
+            try:
+                result_shapes = _shape_list(result_text)
+                operands = _operand_span(line, opcode)
+                flops = _instr_flops(opcode, result_text, line,
+                                     frozenset())
+                bytes_ = _shapes_bytes(result_shapes) \
+                    + _shapes_bytes(_shape_list(operands))
+                dtype = result_shapes[0][0] if result_shapes else None
+            except (ValueError, IndexError):
+                continue
+            out[name] = {"opcode": opcode, "flops": flops,
+                         "bytes": bytes_, "dtype": dtype}
+    return out

@@ -167,6 +167,21 @@ class AnomalyDetector:
             return
         self.captures_started += 1
         self._capture_left = self.capture_steps
+        # A manifest beside the raw trace makes the capture
+        # self-describing: `main.py roofline --from-anomaly` reports
+        # WHY the profiler fired next to the op-level blame, without
+        # re-joining telemetry.  Atomic + advisory, like the dump.
+        try:
+            manifest = {"trigger": verdict, "epoch": epoch, "step": step,
+                        "capture": self.captures_started - 1,
+                        "capture_steps": self.capture_steps}
+            tmp = os.path.join(path, "manifest.json.tmp")
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(manifest, f, indent=2, default=float)
+            os.replace(tmp, os.path.join(path, "manifest.json"))
+        except (OSError, TypeError, ValueError) as e:
+            logging.warning(f"flightrec: capture manifest not written "
+                            f"({e})")
         logging.info(f"flightrec: anomaly ({verdict['trigger']}) at "
                      f"epoch {epoch} step {step} — capturing next "
                      f"{self.capture_steps} step(s) to {path}")

@@ -337,6 +337,21 @@ def report(rsl_path: str) -> str:
         top = max(badput, key=lambda c: badput[c])
         lines.append("  top badput cause: %s (%.2fs, %.1f%% of wall)" % (
             top, badput[top], 100.0 * badput[top] / fleet_wall))
+    # The ledger says WHERE the wall clock went; the roofline report
+    # (when this run profiled) says WHICH op the compute share went to
+    # — point at it so the two layers read as one story.
+    rl_path = os.path.join(rsl_path, "roofline.json")
+    try:
+        with open(rl_path) as f:
+            rl = json.load(f)
+        tops = [r.get("name") for r in (rl.get("ops") or [])[:3]]
+        lines.append(
+            "  op-level blame: %s — top ops %s "
+            "(%.1f%% of step time attributed; see `main.py roofline`)"
+            % (rl_path, ", ".join(t for t in tops if t) or "-",
+               100.0 * float(rl.get("coverage") or 0.0)))
+    except (OSError, ValueError):
+        pass
     return "\n".join(lines)
 
 

@@ -130,6 +130,35 @@ PEAK_BF16_FLOPS = [
     ("v2", 45e12),
 ]
 
+# Published HBM bandwidth per chip (bytes/s), keyed like PEAK_BF16_FLOPS.
+# The roofline classifier (roofline.py) divides the FLOPs peak by this to
+# place the ridge point: ops whose arithmetic intensity falls left of it
+# are memory-bound at any achievable FLOP rate.  Unknown kinds (incl.
+# CPU) report None — the classifier then falls back to a generic ridge
+# and says so in the report.
+PEAK_HBM_BYTES = [
+    ("v6e", 1640e9), ("v6 lite", 1640e9),
+    ("v5p", 2765e9),
+    ("v5e", 819e9), ("v5 lite", 819e9), ("v5litepod", 819e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+]
+
+
+def peak_membw(device_kind) -> Optional[float]:
+    """Peak HBM bytes/s for a ``Device.device_kind``; None when unknown
+    (CPU, future kinds) so callers degrade explicitly instead of
+    fabricating a roofline."""
+    if not device_kind:
+        return None
+    kind = str(device_kind).lower()
+    for key, bw in PEAK_HBM_BYTES:
+        if key in kind:
+            return bw
+    return None
+
+
 # Repo convention for the f32 denominator: half the bf16 peak.  Cloud TPU
 # datasheets publish only the bf16 (and int8) peak; XLA's default f32
 # matmul path feeds the MXU at half the bf16 issue rate, so f32 MFU

@@ -664,3 +664,11 @@ def report(rsl_path: str) -> str:
     """Load + aggregate + render for a run directory (CLI entry)."""
     return render_report(aggregate(load_events(
         os.path.join(rsl_path, "telemetry"))))
+
+
+def json_report(rsl_path: str) -> str:
+    """The same aggregate render_report formats, as JSON — the
+    machine-readable face gate scripts and bench_trend consume instead
+    of scraping the human text (ISSUE 12 satellite)."""
+    agg = aggregate(load_events(os.path.join(rsl_path, "telemetry")))
+    return json.dumps(agg, indent=2, sort_keys=True, default=float)

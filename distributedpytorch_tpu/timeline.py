@@ -416,6 +416,7 @@ def build_timeline(rsl_path: str) -> Dict[str, Any]:
 
     skew = _skew_report(events)
     stragglers = _stragglers(events, dumps, ranks)
+    rooflines = _roofline_summaries(events, rsl_path)
     trace = {
         "traceEvents": trace_events,
         "displayTimeUnit": "ms",
@@ -425,10 +426,52 @@ def build_timeline(rsl_path: str) -> Dict[str, Any]:
             "ranks": ranks,
             "skew": skew,
             "stragglers": stragglers,
+            "roofline": rooflines,
         },
     }
     return {"trace": trace, "skew": skew, "stragglers": stragglers,
-            "ranks": ranks, "alignment": method, "warnings": warnings}
+            "ranks": ranks, "alignment": method, "warnings": warnings,
+            "roofline": rooflines}
+
+
+def _roofline_summaries(events: List[Dict[str, Any]], rsl_path: str
+                        ) -> Dict[str, Any]:
+    """Per-rank op-level blame for the timeline annotation: the newest
+    ``roofline`` telemetry event per rank (roofline.py emits one after
+    every analyzed capture), falling back to RSL_PATH/roofline.json —
+    an offline `main.py roofline` run is rank-agnostic, keyed "*"."""
+    out: Dict[str, Any] = {}
+    for ev in events:
+        if ev.get("kind") != "event" or ev.get("name") != "roofline":
+            continue
+        rank = ev.get("rank")
+        if not isinstance(rank, int):
+            continue
+        prev = out.get(str(rank))
+        if prev and prev.get("_mono", -1) >= ev.get("mono", 0):
+            continue
+        a = _attrs(ev)
+        out[str(rank)] = {"coverage": a.get("coverage"),
+                          "top_ops": a.get("top_ops"),
+                          "source": "telemetry",
+                          "_mono": ev.get("mono", 0)}
+    for v in out.values():
+        v.pop("_mono", None)
+    if not out:
+        try:
+            with open(os.path.join(rsl_path, "roofline.json")) as f:
+                rep = json.load(f)
+            out["*"] = {
+                "coverage": rep.get("coverage"),
+                "top_ops": [{"name": r.get("name"),
+                             "time_share": r.get("time_share"),
+                             "bound": r.get("bound")}
+                            for r in (rep.get("ops") or [])[:3]],
+                "source": "roofline.json",
+            }
+        except (OSError, ValueError):
+            pass
+    return out
 
 
 def render_summary(result: Dict[str, Any], out_path: str) -> str:
@@ -462,6 +505,21 @@ def render_summary(result: Dict[str, Any], out_path: str) -> str:
             f"{row['steps_recorded']:>6d} "
             f"{_f(row['mean_step_s'], '>12.5f')} "
             f"{_f(row['data_wait_share'], '>10.3f')}{flag}")
+    rl = result.get("roofline") or {}
+    if rl:
+        lines.append("roofline attribution (per rank):")
+        for rank in sorted(rl, key=lambda k: (k == "*", k)):
+            info = rl[rank]
+            tops = ", ".join(
+                f"{t['name']} {t['time_share'] * 100:.0f}% "
+                f"({t['bound']}-bound)"
+                for t in (info.get("top_ops") or [])[:3]
+                if t.get("time_share") is not None) or "-"
+            cov = info.get("coverage")
+            cov_s = f"{cov * 100:.1f}%" if cov is not None else "-"
+            who = f"rank {rank}" if rank != "*" else "run"
+            lines.append(f"  {who}: {cov_s} attributed; top: {tops} "
+                         f"[{info.get('source')}]")
     return "\n".join(lines)
 
 

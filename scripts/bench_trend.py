@@ -1,0 +1,48 @@
+#!/usr/bin/env python3
+"""Bench-trend regression ledger (ISSUE 12 satellite) — thin wrapper.
+
+Reads the committed BENCH_r*.json / BENCH_SUITE.json history and prints
+the samples/s-per-chip + MFU trajectory with deltas computed only
+between provenance-clean (``fresh``) rows; exits 1 when the latest
+fresh-vs-fresh delta regresses beyond the threshold.  The logic lives
+in distributedpytorch_tpu/benchtrend.py so `main.py bench-trend` and
+this script cannot drift apart (same pattern as telemetry_report.py).
+
+Usage:
+    python scripts/bench_trend.py [--dir DIR] [--threshold 0.05] [--json]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributedpytorch_tpu import benchtrend  # noqa: E402
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--dir", default=None,
+                   help="directory holding BENCH_r*.json "
+                        "(default: repo root)")
+    p.add_argument("--threshold", type=float,
+                   default=benchtrend.DEFAULT_THRESHOLD,
+                   help="fractional drop in the latest fresh-vs-fresh "
+                        "delta that fails the run (default 0.05)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable verdict output")
+    args = p.parse_args()
+    try:
+        ok, text = benchtrend.run_cli(bench_dir=args.dir,
+                                      threshold=args.threshold,
+                                      as_json=args.json)
+    except ValueError as e:
+        print(f"bench-trend: {e}", file=sys.stderr)
+        return 1
+    print(text)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,7 +47,7 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
-    from bench import peak_flops, _make_corpus
+    from bench import peak_flops, provenance_block, _make_corpus
     from distributedpytorch_tpu import costs, runtime, utils
     from distributedpytorch_tpu.data import augment
     from distributedpytorch_tpu.data.pipeline import ResidentLoader
@@ -174,7 +174,7 @@ def main() -> int:
     # this report and the runtime MFU gauge quote the same numbers.
     compiled = engine.train_epoch.lower(
         state, images_all, labels_all, idx, valid, key).compile()
-    costs.record("train_epoch", compiled)
+    costs.record("train_epoch", compiled, hlo=True)
     st, m = compiled(state, images_all, labels_all, idx, valid, key)
     jax.block_until_ready(m["loss"])
     t0 = time.monotonic()
@@ -211,14 +211,48 @@ def main() -> int:
         "full_step_us": results["full_step"] * 1e6,
     }
 
+    # Per-stage bound classification — the SAME classifier roofline.py
+    # applies per op (shared roofline.bound_class), fed with analytic
+    # stage-level FLOPs/bytes estimates: gather/augment move the batch
+    # without matmul work; forward is 1/3 and backward 2/3 of the
+    # train-step model FLOPs (the standard split ops/flops.py uses);
+    # optimizer+metrics touch every param ~8x (adam reads/writes
+    # params + both moments) for a handful of FLOPs each.
+    from distributedpytorch_tpu.roofline import bound_class
+
+    el_bytes = np.dtype(np.float32).itemsize
+    batch_elems = float(gb * out_dim * out_dim * dataset.channels)
+    params_bytes = float(n_params * el_bytes)
+    stage_costs = {
+        "gather_us": (0.0, 2.0 * batch_elems * el_bytes),
+        "augment_us": (10.0 * batch_elems, 2.0 * batch_elems * el_bytes),
+        "forward_us": (fps * gb / 3.0,
+                       params_bytes + batch_elems * el_bytes),
+        "backward_us": (fps * gb * 2.0 / 3.0, 3.0 * params_bytes),
+        "optimizer_metrics_us": (10.0 * n_params, 8.0 * params_bytes),
+    }
+    stage_classes = {}
+    for stage, (sf, sb) in stage_costs.items():
+        cls = bound_class(sf, sb, device_kind, peak_dtype, stage)
+        stage_classes[stage] = {
+            "bound": cls["bound"], "class_source": cls["class_source"],
+            "arithmetic_intensity": cls["arithmetic_intensity"],
+            "ridge_flops_per_byte": cls["ridge_flops_per_byte"],
+            "ridge_source": cls["ridge_source"],
+        }
+
     # roofline context
     ideal_us = fps * gb / peak * 1e6 if peak else None
     out = {
         "model": args.model, "batch": args.batch, "steps": n_steps,
         "device_kind": device_kind,
+        # Same provenance block as bench.py (ISSUE 12): a stale
+        # PROFILE_BREAKDOWN.json can't masquerade as current.
+        **provenance_block(fresh=True),
         "stage_us_per_step": {k: round(v * 1e6, 2)
                               for k, v in results.items()},
         "breakdown_us": {k: round(v, 2) for k, v in breakdown.items()},
+        "stage_bound_class": stage_classes,
         "train_flops_per_step": fps * gb,
         "ideal_matmul_us_at_peak": round(ideal_us, 2) if ideal_us else None,
         "mfu": (fps * gb / (results["full_step"] * peak)) if peak else None,
@@ -231,7 +265,9 @@ def main() -> int:
     log("")
     log(f"breakdown (us/step, batch {gb}, {device_kind}):")
     for k, v in breakdown.items():
-        log(f"  {k:24s} {v:8.1f}")
+        cls = stage_classes.get(k)
+        tag = f"   {cls['bound']}-bound" if cls else ""
+        log(f"  {k:24s} {v:8.1f}{tag}")
     if ideal_us:
         log(f"  {'ideal_at_peak':24s} {ideal_us:8.1f}   "
             f"(analytic FLOPs / {peak / 1e12:.0f} TF/s {peak_dtype})")

@@ -1,0 +1,175 @@
+#!/usr/bin/env python3
+"""Roofline attribution gate leg (scripts/gate.sh), on CPU.
+
+Three stages, all bounded (~1 min total):
+
+  A. capture + attribute — a 2-epoch synthetic CPU run with --profile
+     (plus AOT warmup so the traced epoch is steady-state) must leave
+     RSL/roofline.json behind via the in-run auto-analysis, with
+     >= 90% of traced device step time attributed to named ops, every
+     op row carrying a compute/memory bound class and its class_source,
+     and a ``roofline`` telemetry event for the timeline merge.
+  B. CLI round trip — ``main.py roofline`` re-analyzes the same trace
+     offline; its --json output must agree with the persisted artifact
+     (same op count, coverage within float noise) and the human table
+     must name the residual explicitly.
+  C. anomaly path — a capture dir shaped like flightrec's output
+     (trace files + manifest.json) under RSL/anomaly_traces; ``main.py
+     roofline --from-anomaly`` must pick the newest capture and carry
+     the trigger manifest into the report.
+
+The bench-trend ledger has its own gate leg (scripts/bench_trend.py
+against the checked-in BENCH history); this file is profiler-side only.
+
+Run as ``env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/roofline_gate.py``.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+COVERAGE_MIN = 0.90
+
+
+def _subenv():
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def main() -> int:
+    from __graft_entry__ import _force_cpu_devices
+
+    _force_cpu_devices(1)
+
+    from distributedpytorch_tpu.cli import run_train
+    from distributedpytorch_tpu.config import Config
+    from distributedpytorch_tpu import roofline, telemetry
+
+    problems = []
+    work = tempfile.mkdtemp(prefix="roofline_gate_")
+    rsl = os.path.join(work, "rsl")
+
+    # -- stage A: profiled run -> in-run auto-analysis ----------------
+    run_train(Config(action="train", data_path="/nodata", rsl_path=rsl,
+                     dataset="synthetic", model_name="mlp", batch_size=8,
+                     nb_epochs=2, debug=True, half_precision=False,
+                     telemetry=True, profile=True, aot_warmup=True))
+
+    trace_dir = os.path.join(rsl, "trace")
+    if not roofline.find_trace_files(trace_dir):
+        problems.append(f"--profile left no trace files under {trace_dir}")
+    doc = None
+    try:
+        with open(os.path.join(rsl, "roofline.json")) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        problems.append(f"in-run auto-analysis wrote no readable "
+                        f"roofline.json ({e})")
+    if doc:
+        if doc["coverage"] < COVERAGE_MIN:
+            problems.append(
+                f"coverage {doc['coverage']:.1%} < {COVERAGE_MIN:.0%} — "
+                f"too much traced step time is unattributed")
+        if not doc["ops"]:
+            problems.append("roofline.json has no op rows")
+        for r in doc["ops"]:
+            if r.get("bound") not in ("compute", "memory"):
+                problems.append(f"op {r.get('name')!r} has no bound "
+                                f"class: {r.get('bound')!r}")
+                break
+            if r.get("class_source") not in ("analytic", "heuristic"):
+                problems.append(f"op {r.get('name')!r} has no "
+                                f"class_source")
+                break
+        if doc["residual_us"] < 0:
+            problems.append("negative unattributed residual")
+        n_analytic = sum(1 for r in doc["ops"]
+                         if r.get("class_source") == "analytic")
+        if n_analytic == 0:
+            problems.append(
+                "no op joined against analytic HLO costs — the "
+                "costs.json hlo capture or the join is broken")
+        evs = telemetry.load_events(os.path.join(rsl, "telemetry"))
+        roofs = [e for e in evs if e.get("kind") == "event"
+                 and e.get("name") == "roofline"]
+        if not roofs:
+            problems.append("no `roofline` telemetry event — the "
+                            "timeline merge has nothing to annotate")
+        print(f"roofline gate A: coverage {doc['coverage']:.1%}, "
+              f"{doc['n_ops']} ops ({n_analytic} analytic), residual "
+              f"{doc['residual_us'] / 1e3:.2f} ms")
+
+    # -- stage B: offline CLI round trip ------------------------------
+    rep = subprocess.run([sys.executable, "main.py", "roofline",
+                          "--rsl_path", rsl, "--json"], cwd=REPO,
+                         env=_subenv(), capture_output=True, text=True)
+    if rep.returncode != 0:
+        problems.append(f"`main.py roofline --json` exited "
+                        f"{rep.returncode}: {rep.stderr[-300:]}")
+    elif doc:
+        try:
+            redoc = json.loads(rep.stdout)
+        except ValueError:
+            problems.append("`main.py roofline --json` printed "
+                            "non-JSON output")
+            redoc = None
+        if redoc:
+            if redoc["n_ops"] != doc["n_ops"] or \
+                    abs(redoc["coverage"] - doc["coverage"]) > 1e-6:
+                problems.append(
+                    f"offline re-analysis disagrees with the in-run "
+                    f"artifact: {redoc['n_ops']} ops at "
+                    f"{redoc['coverage']:.4f} vs {doc['n_ops']} at "
+                    f"{doc['coverage']:.4f}")
+    rep_h = subprocess.run([sys.executable, "main.py", "roofline",
+                            "--rsl_path", rsl], cwd=REPO, env=_subenv(),
+                           capture_output=True, text=True)
+    if rep_h.returncode != 0 or \
+            "unattributed residual" not in rep_h.stdout:
+        problems.append("human-mode `main.py roofline` is missing the "
+                        "explicit unattributed-residual line")
+    else:
+        print("roofline gate B: offline round trip agrees with the "
+              "in-run artifact")
+
+    # -- stage C: --from-anomaly on a flightrec-shaped capture --------
+    cap = os.path.join(rsl, "anomaly_traces", "capture-0")
+    shutil.copytree(trace_dir, os.path.join(cap, "trace"))
+    with open(os.path.join(cap, "manifest.json"), "w") as f:
+        json.dump({"trigger": {"trigger": "step_time_spike"},
+                   "epoch": 1, "step": 7, "capture": 0,
+                   "capture_steps": 4}, f)
+    rep_a = subprocess.run([sys.executable, "main.py", "roofline",
+                            "--rsl_path", rsl, "--from-anomaly"],
+                           cwd=REPO, env=_subenv(),
+                           capture_output=True, text=True)
+    if rep_a.returncode != 0:
+        problems.append(f"`main.py roofline --from-anomaly` exited "
+                        f"{rep_a.returncode}: {rep_a.stderr[-300:]}")
+    elif "step_time_spike" not in rep_a.stdout:
+        problems.append("--from-anomaly report does not carry the "
+                        "capture's trigger manifest")
+    else:
+        print("roofline gate C: anomaly capture analyzed with its "
+              "trigger manifest attached")
+
+    shutil.rmtree(work, ignore_errors=True)
+    if problems:
+        for p in problems:
+            print(f"roofline gate FAIL: {p}")
+        return 1
+    print("roofline gate GREEN")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,9 +26,14 @@ def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--rsl_path", type=str, default="./rsl",
                    help="run directory holding telemetry/ (default ./rsl)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable aggregate (the same dict the "
+                        "human report formats) — what gate scripts "
+                        "consume instead of scraping text")
     args = p.parse_args()
     try:
-        print(telemetry.report(args.rsl_path))
+        print(telemetry.json_report(args.rsl_path) if args.json
+              else telemetry.report(args.rsl_path))
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1

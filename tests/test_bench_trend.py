@@ -1,0 +1,117 @@
+"""Bench-trend regression ledger (distributedpytorch_tpu/benchtrend.py,
+ISSUE 12 satellite): deltas are computed ONLY between provenance-clean
+(``fresh``) rows, replayed rounds are shown but never become a delta
+endpoint, the verdict gates the latest fresh-vs-fresh delta against the
+threshold, and both CLI surfaces exit 1 on a regression.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distributedpytorch_tpu import benchtrend
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIX = os.path.join(REPO, "tests", "fixtures", "benchtrend")
+
+
+def _rows(trend):
+    return {r["round"]: r for r in trend["rounds"]}
+
+
+def test_ok_history_gates_green():
+    trend = benchtrend.build_trend(os.path.join(FIX, "ok"))
+    rows = _rows(trend)
+    # r01 is a legacy row (no fresh flag, no error) -> eligible.
+    assert rows[1]["eligible"] and rows[1]["fresh"] is None
+    assert rows[2]["delta"] == pytest.approx(0.10)
+    # r03 is a replay: shown, excluded, and NEVER a delta endpoint.
+    assert rows[3]["fresh"] is False and not rows[3]["eligible"]
+    assert rows[3]["delta"] is None
+    # r04's delta skips the replay and compares against r02.
+    assert rows[4]["delta"] == pytest.approx(1200.0 / 1100.0 - 1.0)
+    assert trend["latest_delta"] == pytest.approx(1200.0 / 1100.0 - 1.0)
+    assert trend["n_eligible"] == 3
+    assert trend["ok"] and not trend["regression"]
+
+
+def test_replay_never_used_as_delta_endpoint_even_at_tail():
+    # The history ends on a wildly-off replay (value 1 vs 1000): if the
+    # ledger ever differenced it, this would read as a -99.9% crash.
+    trend = benchtrend.build_trend(os.path.join(FIX, "replay_tail"))
+    rows = _rows(trend)
+    assert rows[2]["fresh"] is False
+    assert rows[2]["delta"] is None and not rows[2]["eligible"]
+    assert trend["latest_delta"] is None
+    assert trend["ok"]
+    assert any("delta-eligible" in n for n in trend["notes"])
+
+
+def test_regression_flips_verdict_and_exit_code():
+    d = os.path.join(FIX, "regress")
+    trend = benchtrend.build_trend(d)
+    assert trend["latest_delta"] == pytest.approx(-0.25)
+    assert trend["regression"] and not trend["ok"]
+    ok, text = benchtrend.run_cli(bench_dir=d)
+    assert not ok and "REGRESSION" in text
+    # A looser threshold keeps the same history green: configurable.
+    ok2, _ = benchtrend.run_cli(bench_dir=d, threshold=0.30)
+    assert ok2
+
+
+def test_round_file_headline_extracted_from_tail():
+    trend = benchtrend.build_trend(os.path.join(FIX, "round_file"))
+    rows = _rows(trend)
+    assert rows[1]["value"] == pytest.approx(900.0)
+    assert rows[2]["delta"] == pytest.approx(0.10)
+
+
+def test_no_history_raises():
+    with pytest.raises(ValueError, match="no BENCH_r"):
+        benchtrend.build_trend("/nonexistent/dir")
+
+
+def test_unreadable_round_is_reported_not_fatal(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text("{not json")
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"metric": "m", "value": 5.0, "fresh": True}))
+    trend = benchtrend.build_trend(str(tmp_path))
+    rows = _rows(trend)
+    assert "unreadable" in rows[1]["note"]
+    assert rows[2]["eligible"] and trend["ok"]
+
+
+def test_json_mode_is_machine_readable():
+    ok, text = benchtrend.run_cli(bench_dir=os.path.join(FIX, "ok"),
+                                  as_json=True)
+    doc = json.loads(text)
+    assert ok and doc["ok"] and doc["schema"] == benchtrend.SCHEMA
+
+
+def test_script_exits_1_on_regression_and_0_on_ok():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    script = os.path.join(REPO, "scripts", "bench_trend.py")
+    r = subprocess.run([sys.executable, script, "--dir",
+                        os.path.join(FIX, "regress")],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "REGRESSION" in r.stdout
+    r = subprocess.run([sys.executable, script, "--dir",
+                        os.path.join(FIX, "ok"), "--json"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.loads(r.stdout)["ok"]
+
+
+def test_checked_in_history_is_green():
+    # The repo's own BENCH_r*.json trajectory must pass its own gate.
+    trend = benchtrend.build_trend()  # repo root
+    assert trend["ok"], trend
+    # r05 (legacy replay with error) and r06 (fresh: false) never carry
+    # a delta — the provenance rule on the real history, not a fixture.
+    for r in trend["rounds"]:
+        if r["round"] in (5, 6):
+            assert not r["eligible"] and r["delta"] is None

@@ -1,0 +1,264 @@
+"""Roofline attribution (distributedpytorch_tpu/roofline.py, ISSUE 12
+tentpole): the trace parser must attribute nested op slices exactly once
+(self-time), exclude the python dispatch thread from the step-time
+denominator, survive torn captures with an explicit warning, join ops
+against HLO-derived analytic costs, degrade to name heuristics with an
+explicit residual when no cost metadata exists, and round-trip a real
+CPU ``jax.profiler`` capture end to end.
+
+The ``wellformed`` fixture is hand-built so every expected number is
+derivable on paper: a device thread with a 100us runtime envelope, a
+40us ``dot.1``, a 30us ``fusion.2``, and a 20us ``while.3`` whose body
+re-runs ``dot.1`` for 10us (nesting!), plus a python thread with a
+1000us epoch-long slice that must NOT count toward step time.
+"""
+
+import gzip
+import json
+import os
+
+import pytest
+
+from distributedpytorch_tpu import costs, roofline
+
+FIX = os.path.join(os.path.dirname(__file__), "fixtures", "roofline")
+WELLFORMED = os.path.join(FIX, "wellformed")
+TORN = os.path.join(FIX, "torn")
+TORN_ONLY = os.path.join(FIX, "torn_only")
+
+# HLO whose instruction names match the fixture trace's op names, in the
+# exact textual shape ``compiled.as_text()`` emits on jax 0.4.37.
+FIXTURE_HLO = """\
+HloModule jit_step, entry_computation_layout={(f32[64,64]{1,0}, f32[64,64]{1,0})->f32[64,64]{1,0}}
+
+%fused_computation (param_0.1: f32[64,64]) -> f32[64,64] {
+  %param_0.1 = f32[64,64]{1,0} parameter(0)
+  ROOT %add.9 = f32[64,64]{1,0} add(f32[64,64]{1,0} %param_0.1, f32[64,64]{1,0} %param_0.1)
+}
+
+ENTRY %main.10 (Arg_0.1: f32[64,64], Arg_1.2: f32[64,64]) -> f32[64,64] {
+  %Arg_0.1 = f32[64,64]{1,0} parameter(0)
+  %Arg_1.2 = f32[64,64]{1,0} parameter(1)
+  %dot.1 = f32[64,64]{1,0} dot(f32[64,64]{1,0} %Arg_0.1, f32[64,64]{1,0} %Arg_1.2), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %fusion.2 = f32[64,64]{1,0} fusion(f32[64,64]{1,0} %dot.1), kind=kLoop, calls=%fused_computation
+}
+"""
+
+COSTS_DATA = {"device_kind": "cpu",
+              "programs": {"step": {"hlo": FIXTURE_HLO}}}
+
+
+# -- trace parsing -----------------------------------------------------
+
+
+def test_wellformed_parse_exact_numbers():
+    parsed = roofline.parse_trace_dir(WELLFORMED)
+    # Step time is the device thread's activity union, NOT the python
+    # thread's 1000us slice.
+    assert parsed["step_time_us"] == pytest.approx(100.0)
+    # dot.1(0,40) + fusion.2(50,80) + while.3(80,100) union = 90us.
+    assert parsed["attributed_us"] == pytest.approx(90.0)
+    assert parsed["residual_us"] == pytest.approx(10.0)
+    assert parsed["coverage"] == pytest.approx(0.9)
+    assert parsed["warnings"] == []
+    ops = parsed["ops"]
+    # Self-time: the while body's nested dot.1 (10us) is charged to
+    # dot.1, not double-counted under while.3.
+    assert ops[("jit_step", "dot.1")] == {"time_us": pytest.approx(50.0),
+                                          "count": 2}
+    assert ops[("jit_step", "fusion.2")]["time_us"] == pytest.approx(30.0)
+    assert ops[("jit_step", "while.3")]["time_us"] == pytest.approx(10.0)
+
+
+def test_torn_file_warns_but_result_survives():
+    parsed = roofline.parse_trace_dir(TORN)
+    assert any("torn" in w for w in parsed["warnings"])
+    assert parsed["n_trace_files"] == 1  # the intact sibling
+    assert ("jit_step", "dot.1") in parsed["ops"]
+
+
+def test_all_torn_raises():
+    with pytest.raises(ValueError, match="torn or unparseable"):
+        roofline.parse_trace_dir(TORN_ONLY)
+
+
+def test_empty_dir_raises(tmp_path):
+    with pytest.raises(ValueError, match="no profiler trace files"):
+        roofline.parse_trace_dir(str(tmp_path))
+
+
+def test_trace_without_op_events_raises(tmp_path):
+    d = tmp_path / "plugins" / "profile" / "t"
+    d.mkdir(parents=True)
+    (d / "h.trace.json").write_text(json.dumps({"traceEvents": [
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 5,
+         "name": "host_only"}]}))
+    with pytest.raises(ValueError, match="no per-op"):
+        roofline.parse_trace_dir(str(tmp_path))
+
+
+# -- cost join + classification ----------------------------------------
+
+
+def test_analytic_join_and_bound_classes():
+    rep = roofline.analyze(WELLFORMED, costs_data=COSTS_DATA)
+    rows = {r["name"]: r for r in rep["ops"]}
+    dot = rows["dot.1"]
+    # 2 * 64*64 result elems * K=64 contracted.
+    assert dot["flops"] == pytest.approx(2 * 64 * 64 * 64)
+    assert dot["bytes"] == pytest.approx(3 * 64 * 64 * 4)
+    assert dot["class_source"] == "analytic"
+    # AI = 524288/49152 = 10.67 >= generic ridge 10 -> compute-bound.
+    assert dot["bound"] == "compute"
+    fus = rows["fusion.2"]
+    # Fusion flops = fused computation's add (4096 elems); bytes = its
+    # own operand + result footprint only.
+    assert fus["flops"] == pytest.approx(64 * 64)
+    assert fus["bound"] == "memory"
+    assert fus["class_source"] == "analytic"
+    # while.3 has no HLO-derived costs -> name heuristic, still a class.
+    wh = rows["while.3"]
+    assert wh["class_source"] == "heuristic"
+    assert wh["bound"] == "memory"
+    assert all(r["bound"] in ("compute", "memory") for r in rep["ops"])
+    # CPU has no peak tables: the ceiling degrades to the best observed
+    # rate in this trace, labeled empirical, never silently "device".
+    assert dot["ceiling_source"] == "empirical"
+    assert 0.0 < dot["utilization"] <= 1.0
+
+
+def test_missing_cost_metadata_degrades_with_explicit_residual():
+    rep = roofline.analyze(WELLFORMED)  # no costs.json anywhere
+    assert any("no costs.json" in w for w in rep["warnings"])
+    assert rep["residual_us"] == pytest.approx(10.0)
+    for r in rep["ops"]:
+        assert r["class_source"] == "heuristic"
+        assert r["bound"] in ("compute", "memory")
+    rows = {r["name"]: r for r in rep["ops"]}
+    assert rows["dot.1"]["bound"] == "compute"  # name hint
+    txt = roofline.render_report(rep)
+    assert "unattributed residual: 0.01 ms" in txt
+    assert "heuristic" in txt
+
+
+def test_device_ridge_when_peaks_known():
+    cls = roofline.bound_class(1e9, 1e6, device_kind="TPU v4", dtype="bf16")
+    assert cls["ridge_source"] == "device"
+    assert cls["bound"] == "compute"
+    cls2 = roofline.bound_class(1.0, 1e6, device_kind="TPU v4",
+                                dtype="bf16")
+    assert cls2["bound"] == "memory"
+
+
+# -- persistence, telemetry, CLI ---------------------------------------
+
+
+def test_save_report_roundtrips(tmp_path):
+    rep = roofline.analyze(WELLFORMED, costs_data=COSTS_DATA)
+    path = roofline.save_report(rep, str(tmp_path))
+    with open(path) as f:
+        back = json.load(f)
+    assert back["coverage"] == pytest.approx(0.9)
+    assert back["schema"] == roofline.SCHEMA
+    assert len(back["ops"]) == 3
+
+
+def test_run_cli_persists_and_emits_telemetry(tmp_path):
+    out = roofline.run_cli(str(tmp_path), trace_dir=WELLFORMED)
+    assert "roofline attribution" in out
+    assert os.path.exists(tmp_path / "roofline.json")
+    tel_dir = tmp_path / "telemetry"
+    events = []
+    for f in os.listdir(tel_dir):
+        with open(tel_dir / f) as fh:
+            events += [json.loads(line) for line in fh if line.strip()]
+    roof = [e for e in events if e.get("name") == "roofline"]
+    assert roof and roof[0]["attrs"]["coverage"] == pytest.approx(0.9)
+    assert roof[0]["attrs"]["top_ops"][0]["name"] == "dot.1"
+
+
+def test_run_cli_json_mode(tmp_path):
+    out = roofline.run_cli(str(tmp_path), trace_dir=WELLFORMED,
+                           as_json=True, emit_events=False)
+    doc = json.loads(out)
+    assert doc["coverage"] == pytest.approx(0.9)
+
+
+def test_run_cli_from_anomaly_reads_manifest(tmp_path):
+    cap = tmp_path / "anomaly_traces" / "capture-0"
+    src = os.path.join(WELLFORMED, "plugins", "profile",
+                       "2026_08_05_00_00_00", "host.trace.json")
+    dst = cap / "plugins" / "profile" / "t" / "host.trace.json"
+    dst.parent.mkdir(parents=True)
+    dst.write_text(open(src).read())
+    (cap / "manifest.json").write_text(json.dumps(
+        {"trigger": {"trigger": "loss_spike"}, "epoch": 3, "step": 17,
+         "capture": 0}))
+    out = roofline.run_cli(str(tmp_path), from_anomaly=True,
+                           emit_events=False)
+    assert "anomaly capture 0" in out
+    assert "loss_spike" in out
+    with open(tmp_path / "roofline.json") as f:
+        assert json.load(f)["anomaly"]["step"] == 17
+
+
+def test_run_cli_no_anomaly_captures_raises(tmp_path):
+    with pytest.raises(ValueError, match="no anomaly captures"):
+        roofline.run_cli(str(tmp_path), from_anomaly=True,
+                         emit_events=False)
+
+
+# -- HLO per-op cost parser --------------------------------------------
+
+
+def test_hlo_op_costs_fixture_text():
+    m = costs.hlo_op_costs(FIXTURE_HLO)
+    assert m["dot.1"]["flops"] == pytest.approx(2 * 64 * 64 * 64)
+    assert m["dot.1"]["opcode"] == "dot"
+    assert m["fusion.2"]["flops"] == pytest.approx(64 * 64)
+    assert m["fusion.2"]["dtype"] == "f32"
+    assert "Arg_0.1" not in m  # parameters carry no cost rows
+
+
+def test_hlo_op_costs_against_real_compiled_text():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    def f(a, b):
+        return jnp.tanh(a @ b).sum()
+
+    a = jnp.ones((32, 16), jnp.float32)
+    b = jnp.ones((16, 8), jnp.float32)
+    text = jax.jit(f).lower(a, b).compile().as_text()
+    m = costs.hlo_op_costs(text)
+    dots = [v for v in m.values() if v["opcode"] == "dot"]
+    assert dots and dots[0]["flops"] == pytest.approx(2 * 32 * 8 * 16)
+
+
+# -- end-to-end: capture a real CPU trace, parse it back ---------------
+
+
+def test_cpu_profiler_capture_round_trip(tmp_path):
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(a, b):
+        return jnp.tanh(a @ b).sum()
+
+    a = jnp.ones((64, 64), jnp.float32)
+    b = jnp.ones((64, 64), jnp.float32)
+    step(a, b).block_until_ready()  # compile outside the capture
+    trace_dir = str(tmp_path / "trace")
+    jax.profiler.start_trace(trace_dir)
+    try:
+        for _ in range(5):
+            step(a, b).block_until_ready()
+    finally:
+        jax.profiler.stop_trace()
+    rep = roofline.analyze(trace_dir)
+    assert rep["n_ops"] >= 1
+    assert 0.0 < rep["coverage"] <= 1.0
+    assert all(r["bound"] in ("compute", "memory") for r in rep["ops"])
+    # and the renderer handles a real report without blowing up
+    assert "attributed" in roofline.render_report(rep)
