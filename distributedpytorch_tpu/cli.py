@@ -593,7 +593,22 @@ def run_train(cfg: Config) -> dict:
     # live for the initialize call itself.
     faults.configure(cfg.fault_plan, cfg.fault_seed, cfg.retry_max_attempts,
                      cfg.retry_base_delay, cfg.retry_timeout)
-    runtime.initialize_distributed(elastic=cfg.elastic)
+    join_info = None
+    if cfg.elastic_join:
+        if not cfg.elastic:
+            raise ValueError(
+                "--elastic-join requires --elastic: a joiner becomes a "
+                "normal elastic member and must keep reconfiguring with "
+                "its world")
+        join_info = runtime.join_distributed(
+            cfg.elastic_dir or elastic.default_elastic_dir(cfg.rsl_path))
+    else:
+        runtime.initialize_distributed(elastic=cfg.elastic)
+    if cfg.elastic:
+        # Parse the admission policy NOW: a malformed --elastic-target
+        # must fail at launch, not at the first health boundary mid-run.
+        elastic.evaluate_join_policy(1, [], cfg.elastic_target,
+                                     cfg.elastic_min_world)
     utils.initialize_logging(cfg.rsl_path, cfg.log_file,
                              truncate=runtime.is_main())
     # After distributed init so the rank in the filename is the GLOBAL
@@ -638,6 +653,22 @@ def run_train(cfg: Config) -> dict:
               dataset=cfg.dataset, world=world,
               processes=runtime.process_count(),
               batch_per_replica=cfg.batch_size)
+    if join_info is not None:
+        # The joiner's birth certificate: names the generation it was
+        # admitted into, marks its telemetry stream (which may be a
+        # departed rank's file, reopened in append) as restarted from
+        # this instant — the timeline merger cuts alignment here — and
+        # tells report aggregation this rank appeared mid-run by
+        # design, not by accident.
+        tel.event("elastic/join", generation=join_info["generation"],
+                  new_world=join_info["new_world"],
+                  new_rank=join_info["new_rank"],
+                  coordinator=join_info["coordinator"])
+        tel.gauge("elastic/world_size").set(join_info["new_world"])
+        tel.flush()
+        flightrec.get().record_event("elastic_join",
+                                     generation=join_info["generation"],
+                                     new_world=join_info["new_world"])
     if runtime.is_main():
         logging.info(f"process: {runtime.process_index()}/"
                      f"{runtime.process_count()}, world size: {world}")
@@ -789,6 +820,12 @@ def run_train(cfg: Config) -> dict:
     start_time = utils.monotonic()
     shutdown = utils.GracefulShutdown()
     resume_file = cfg.checkpoint_file
+    if join_info is not None and not resume_file:
+        # A joiner resumes from the newest lineage-verified snapshot of
+        # the run it joined — the same file its new peers restore after
+        # their grow reconfigure (both sides land on the same epoch).
+        resume_file = ckpt.newest_checkpoint(cfg.rsl_path, cfg.dataset,
+                                             model_name)
     reconfigures = 0
     try:
         with shutdown:
@@ -802,10 +839,11 @@ def run_train(cfg: Config) -> dict:
                                         resume_file, start_time, shutdown,
                                         saver)
                 except elastic.WorldChangedError as e:
+                    grow = bool(getattr(e, "grow", False))
                     reconfigures += 1
                     if reconfigures > cfg.max_reconfigures:
                         raise faults.PeerFailureError(
-                            f"world shrank {reconfigures} times, over "
+                            f"world changed {reconfigures} times, over "
                             f"the --max-reconfigures {cfg.max_reconfigures}"
                             " cap; exiting with the last failure") from e
                     # Release everything that pins the old backend —
@@ -833,7 +871,7 @@ def run_train(cfg: Config) -> dict:
                 # sequence is goodput elastic_reconfigure (the restore
                 # itself lands in ckpt_blocking inside _train_world).
                 with goodput.get().timed("elastic_reconfigure"):
-                    mesh = _elastic_reconfigure(cfg, tel, saver)
+                    mesh = _elastic_reconfigure(cfg, tel, saver, grow)
                     if isinstance(train_loader, ShardedLoader):
                         # Deterministic reshard: same split/settings,
                         # re-derived rank slices for the new world.
@@ -943,6 +981,14 @@ def _train_world(cfg: Config, model_name: str, dataset: Dataset, mesh,
         state = _place_state(state, mesh, cfg)
         start_epoch, best_valid_loss = 0, float("inf")
 
+    if cfg.elastic and elastic.generation() > 0:
+        # Post-reconfigure resume point: which epoch this generation's
+        # world picked up from.  The chaos grow gate reads this back to
+        # locate the snapshot an uninterrupted reference must share.
+        tel.event("elastic/resume", generation=elastic.generation(),
+                  epoch=start_epoch, world=world)
+        tel.flush()
+
     if cfg.aot_warmup:
         _aot_warmup(cfg, engine, state, train_loader, valid_loader, root,
                     start_epoch)
@@ -958,16 +1004,18 @@ def _train_world(cfg: Config, model_name: str, dataset: Dataset, mesh,
                              start_time, world, shutdown, saver)
 
 
-def _elastic_reconfigure(cfg: Config, tel, saver):
-    """Shrink into the surviving world; returns the new mesh.
+def _elastic_reconfigure(cfg: Config, tel, saver, grow: bool = False):
+    """Shrink into the surviving world — or grow into the admitted one —
+    and return the new mesh.
 
     Sequence (each step's rationale in elastic.py): drain pending async
     checkpoint writes (the newest snapshot is what the new world resumes
     from), dump the flight recorder (the departed rank's last minutes
-    are the post-mortem), rendezvous + re-init the collective runtime,
-    then rebuild the mesh against the new backend.  Telemetry keeps the
-    ORIGINAL rank file — stable per-process streams are what the
-    timeline merger aligns on across the reconfigure boundary.
+    are the post-mortem; on a grow, the pre-grow world's record), then
+    rendezvous + re-init the collective runtime and rebuild the mesh
+    against the new backend.  Telemetry keeps the ORIGINAL rank file —
+    stable per-process streams are what the timeline merger aligns on
+    across the reconfigure boundary.
     """
     if saver is not None:
         try:
@@ -982,10 +1030,13 @@ def _elastic_reconfigure(cfg: Config, tel, saver):
     old_world = runtime.process_count()
     elastic_dir = cfg.elastic_dir or elastic.default_elastic_dir(
         cfg.rsl_path)
-    info = elastic.reconfigure(elastic_dir, old_rank, old_world)
+    info = elastic.reconfigure(elastic_dir, old_rank, old_world,
+                               grow=grow, target=cfg.elastic_target,
+                               min_world=cfg.elastic_min_world)
     tel.event("elastic/reconfigure", generation=info["generation"],
               old_world=old_world, new_world=info["new_world"],
               old_rank=old_rank, new_rank=info["new_rank"],
+              grow=grow, joined=info.get("joiners", []),
               coordinator=info["coordinator"])
     tel.gauge("elastic/world_size").set(info["new_world"])
     tel.flush()
@@ -1017,17 +1068,21 @@ def _peer_loss_exit(tel, epoch: int, err, elastic_on: bool):
 
 def _health_boundary(tel, shutdown, epoch: int, err, cfg=None) -> bool:
     """Epoch/chunk-boundary failure agreement.  ONE allgather carries
-    both the fatal flag and the shutdown flag (runtime.agree_health), so
-    the collective schedule on healthy ranks is unchanged from the old
-    shutdown-only check.  A rank that failed host-side re-raises its own
-    error; its peers raise PeerFailureError — every rank exits together,
-    none hangs waiting in a later collective.  Under --elastic a peer
-    VANISHING (vs failing and reporting) becomes WorldChangedError — the
-    signal for run_train's elastic loop to shrink and resume — and
-    --health-timeout bounds the agreement itself so a dead peer that
-    never reaches this boundary yields a local verdict instead of a
-    deadlock.  Returns True when the run should stop cleanly
-    (preemption)."""
+    the fatal flag, the shutdown flag, and the elastic grow vote
+    (runtime.agree_health), so the collective schedule on healthy ranks
+    is unchanged from the old shutdown-only check.  A rank that failed
+    host-side re-raises its own error; its peers raise PeerFailureError
+    — every rank exits together, none hangs waiting in a later
+    collective.  Under --elastic a peer VANISHING (vs failing and
+    reporting) becomes WorldChangedError — the signal for run_train's
+    elastic loop to shrink and resume — and --health-timeout bounds the
+    agreement itself so a dead peer that never reaches this boundary
+    yields a local verdict instead of a deadlock.  An admissible join
+    claim in the rendezvous dir (scanned just before the allgather)
+    becomes WorldChangedError with ``grow=True`` — same loop, larger
+    world.  Failure and preemption outrank a grow: a claim seen at a
+    failing boundary stays pending for the shrunken world's next one.
+    Returns True when the run should stop cleanly (preemption)."""
     elastic_on = bool(cfg is not None and cfg.elastic)
     tel.flush()  # boundary: buffered events hit the disk
     if elastic.is_peer_loss(err):
@@ -1035,13 +1090,15 @@ def _health_boundary(tel, shutdown, epoch: int, err, cfg=None) -> bool:
         # the dead peer is gone, so the agreement allgather below would
         # ride the same broken channel.  The local error is the verdict.
         _peer_loss_exit(tel, epoch, err, elastic_on)
+    admit_ids = _scan_grow(cfg, tel, epoch) if elastic_on else []
     timeout_s = (cfg.health_timeout if cfg is not None else 0.0) or None
     try:
         # The allgather's duration IS the straggler wait: every rank
         # blocks here until the slowest arrives (goodput collective_skew).
         with goodput.get().timed("collective_skew"):
-            any_failed, any_shutdown = runtime.agree_health(
-                err is not None, shutdown.requested, timeout_s=timeout_s)
+            any_failed, any_shutdown, any_grow = runtime.agree_health(
+                err is not None, shutdown.requested, timeout_s=timeout_s,
+                grow=bool(admit_ids))
     except faults.HealthTimeoutError as timeout_err:
         # Bounded failure detection: the peer died BETWEEN collectives
         # and never reached this boundary — without the bound the
@@ -1089,7 +1146,49 @@ def _health_boundary(tel, shutdown, epoch: int, err, cfg=None) -> bool:
             logging.info(f"preempted after epoch {epoch + 1}: "
                          f"checkpoint written, resume with -f")
         return True
+    if any_grow and elastic_on:
+        # Every rank agreed (one vote suffices — the OR repairs the
+        # filesystem-polling race): park this world and re-rendezvous
+        # with the joiners included.  The rendezvous coordinator's
+        # re-scan of the claims is the authoritative admission.
+        tel.event("elastic/grow", epoch=epoch, joiners=admit_ids)
+        tel.flush()
+        raise elastic.WorldChangedError(
+            f"join claim(s) admitted at the epoch {epoch + 1} boundary;"
+            " growing the world", grow=True)
     return False
+
+
+def _scan_grow(cfg, tel, epoch: int) -> list:
+    """Health-boundary autoscaling poll: pending join claims through
+    the --elastic-target policy.  Declines are answered here by the
+    main rank — the claimant stops waiting and the world never pays a
+    reconfigure window for them; admissions only raise this rank's grow
+    vote for the agreement allgather.  Any filesystem hiccup skips the
+    scan (the next boundary retries) rather than failing a healthy
+    boundary."""
+    elastic_dir = cfg.elastic_dir or elastic.default_elastic_dir(
+        cfg.rsl_path)
+    try:
+        admit, declined = elastic.scan_joins(
+            elastic_dir, runtime.process_count(), cfg.elastic_target,
+            cfg.elastic_min_world)
+        if declined and runtime.is_main():
+            elastic.decline_joins(elastic_dir, declined,
+                                  elastic.generation() + 1)
+            for jid, reason in declined:
+                tel.event("elastic/join_declined", epoch=epoch,
+                          join_id=jid, reason=reason,
+                          target=cfg.elastic_target,
+                          min_world=cfg.elastic_min_world)
+    except OSError as e:
+        logging.warning(f"elastic: join scan failed at the epoch "
+                        f"{epoch + 1} boundary (retrying next): {e}")
+        return []
+    if admit:
+        tel.event("elastic/join_admit", epoch=epoch, joiners=admit,
+                  target=cfg.elastic_target)
+    return admit
 
 
 def _run_train_epochs(cfg: Config, engine: Engine, state, train_loader,

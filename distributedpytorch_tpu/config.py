@@ -197,17 +197,27 @@ class Config:
     retry_max_attempts: int = 3
     retry_base_delay: float = 0.05
     retry_timeout: float = 60.0
-    # Elastic training (elastic.py, ISSUE 10): survive rank loss by
-    # reconfiguring into the surviving world instead of exiting at the
-    # failure agreement.  elastic_dir is the shared rendezvous dir
-    # (default RSL_PATH/elastic); health_timeout bounds the boundary
+    # Elastic training (elastic.py, ISSUE 10 + 13): survive rank loss
+    # by reconfiguring into the surviving world instead of exiting at
+    # the failure agreement, and grow the world back when join claims
+    # appear.  elastic_dir is the shared rendezvous dir (default
+    # RSL_PATH/elastic); health_timeout bounds the boundary
     # agree_health allgather so a dead peer becomes a local verdict
     # instead of a deadlock (0 = unbounded, the pre-elastic behavior);
-    # max_reconfigures caps shrink rounds per process.
+    # max_reconfigures caps reconfigure rounds (shrink or grow) per
+    # process.  elastic_target is the autoscaling policy ('capacity'
+    # admits every join claim, 'fixed:N' admits up to a world of N);
+    # elastic_min_world declines join batches that would still leave
+    # the world under the floor; elastic_join makes THIS process a
+    # joiner: drop a claim in elastic_dir and enter the world the
+    # coordinator admits it into instead of initializing one.
     elastic: bool = False
     elastic_dir: Optional[str] = None
     health_timeout: float = 0.0
     max_reconfigures: int = 3
+    elastic_target: str = "capacity"
+    elastic_min_world: int = 1
+    elastic_join: bool = False
     # Rolling-checkpoint lineage depth: how many per-epoch snapshots are
     # retained (1 = the reference delete-previous behavior; >1 gives the
     # corruption-fallback resume earlier snapshots to walk back to).
@@ -357,8 +367,9 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                         "(';'-separated, e.g. 'data.read:ioerror:2') or a "
                         "JSON plan file; sites: data.read data.host_batch "
                         "ckpt.save ckpt.finalize ckpt.restore runtime.init "
-                        "elastic.reinit telemetry.write; kinds: ioerror "
-                        "fatal preempt torn stall rank_loss (default: no "
+                        "elastic.reinit elastic.join elastic.grow_reinit "
+                        "telemetry.write; kinds: ioerror fatal preempt "
+                        "torn stall rank_loss rank_join (default: no "
                         "faults, zero overhead)")
     p.add_argument("--fault-seed", type=int, default=0, dest="faultSeed",
                    metavar="S",
@@ -402,9 +413,31 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                         "(default 0 = unbounded)")
     p.add_argument("--max-reconfigures", type=int, default=3,
                    dest="maxReconfigures", metavar="N",
-                   help="cap on elastic shrink rounds per process; "
-                        "exceeding it exits with the underlying error "
-                        "(default 3)")
+                   help="cap on elastic reconfigure rounds (shrink or "
+                        "grow) per process; exceeding it exits with the "
+                        "underlying error (default 3)")
+    p.add_argument("--elastic-target", type=str, default="capacity",
+                   dest="elasticTarget", metavar="POLICY",
+                   help="autoscaling admission policy for join claims "
+                        "at each health boundary: 'capacity' admits "
+                        "every claim (scale to whatever shows up), "
+                        "'fixed:N' admits only up to a world of N "
+                        "(default capacity)")
+    p.add_argument("--elastic-min-world", type=int, default=1,
+                   dest="elasticMinWorld", metavar="N",
+                   help="floor for elastic grow admissions: a join "
+                        "batch whose admission would still leave the "
+                        "world below N is declined whole — the "
+                        "reconfigure window is not worth paying "
+                        "(default 1)")
+    p.add_argument("--elastic-join", action="store_true",
+                   dest="elasticJoin",
+                   help="join a running --elastic world instead of "
+                        "initializing one: drop a join claim in "
+                        "--elastic-dir, wait for the coordinator's "
+                        "admit/decline verdict, and enter the grown "
+                        "world at the rank it assigns (fresh capacity "
+                        "or a departed rank restarting)")
     p.add_argument("--keep-ckpts", type=int, default=1, dest="keepCkpts",
                    metavar="K",
                    help="rolling-checkpoint lineage depth: retain the K "
@@ -715,6 +748,9 @@ def config_from_argv(argv=None) -> Config:
         elastic_dir=args.elasticDir,
         health_timeout=args.healthTimeout,
         max_reconfigures=args.maxReconfigures,
+        elastic_target=args.elasticTarget,
+        elastic_min_world=args.elasticMinWorld,
+        elastic_join=args.elasticJoin,
         keep_ckpts=args.keepCkpts,
         compilation_cache_dir=args.compilationCacheDir,
         no_compile_cache=args.noCompileCache,

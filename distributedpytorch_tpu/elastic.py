@@ -72,6 +72,23 @@ client is only ever noticed through the (disabled) heartbeats.  So
 ``quiesce_exit`` is also a barrier: peers drop a done marker in the
 final generation's directory and leave; a service host waits
 (bounded) for all of its peers' markers before it exits.
+
+Worlds grow, too.  A joining process (fresh capacity, or a departed
+rank restarting) drops a join-claim under ``<elastic-dir>/joins/`` and
+waits.  Survivors poll that directory at every health boundary: when
+the admission policy (``--elastic-target``) says yes, they agree to
+grow through the same one-allgather health agreement that reports
+failure, then run the SAME park/rendezvous/re-init machinery as a
+shrink — except the claim set is complete (nothing died) and the
+published ``world.json`` carries a ``joiners`` list.  The coordinator
+answers each admitted claim with an ``admit-<id>.json`` marker naming
+the joiner's new rank and the new coordinator address; the joiner
+connects with ``manual_init`` and enters the run loop as a normal
+member, restoring the newest lineage-verified checkpoint.  Declined
+claims (over a ``fixed:N`` target, or a batch that cannot reach
+``--elastic-min-world``) get a ``decline-<id>.json`` marker so the
+joiner exits loudly instead of waiting forever.  A grow costs the
+survivors one reconfigure window — the same price as a shrink.
 """
 
 from __future__ import annotations
@@ -122,23 +139,35 @@ RENDEZVOUS_DEADLINE_S = 120.0
 # peers' done markers before exiting anyway (a peer that crashed after
 # the reconfigure will never write one).
 QUIESCE_BARRIER_S = 60.0
+# How long a joiner waits for an admit/decline marker after dropping
+# its claim.  Survivors only scan claims at health boundaries (epoch
+# ends), so this must dominate an epoch plus a reconfigure window.
+JOIN_WAIT_S = 600.0
 
 
 class WorldChangedError(RuntimeError):
-    """Control-flow signal, not a failure: the collective world lost a
-    member and this (healthy, --elastic) rank should reconfigure and
-    resume instead of exiting.  Raised by the health boundary, caught
-    by the elastic retraining loop in cli.run_train."""
+    """Control-flow signal, not a failure: the collective world changed
+    membership (a member was lost, or a joiner was admitted) and this
+    (healthy, --elastic) rank should reconfigure and resume instead of
+    exiting.  Raised by the health boundary, caught by the elastic
+    retraining loop in cli.run_train.  ``grow`` distinguishes the two:
+    a grow parks and re-rendezvouses exactly like a shrink, but the
+    full old world is still alive and the claim set includes joiners."""
+
+    def __init__(self, msg: str, grow: bool = False):
+        super().__init__(msg)
+        self.grow = grow
 
 
 def generation() -> int:
-    """0 before any reconfigure, then 1, 2, ... per shrink."""
+    """0 before any reconfigure, then 1, 2, ... per shrink or grow."""
     return _generation
 
 
 def reconfigured() -> bool:
     """True once this process has torn down and re-joined at least one
-    shrunken world — drivers must then exit via ``quiesce_exit``."""
+    reconfigured world (shrunken or grown), or joined one mid-run —
+    drivers must then exit via ``quiesce_exit``."""
     return _reconfigured
 
 
@@ -453,10 +482,228 @@ def _claimed_ranks(gen_dir: str) -> List[int]:
     return sorted(ranks)
 
 
+# -- join claims + admission policy (the grow half) -------------------
+
+
+class JoinDeclinedError(RuntimeError):
+    """The coordinator answered this join claim with a decline marker
+    (over a fixed target, or the batch could not reach the min-world
+    floor).  The joiner exits loudly instead of waiting forever."""
+
+
+def _joins_dir(elastic_dir: str) -> str:
+    return os.path.join(elastic_dir, "joins")
+
+
+def request_join(elastic_dir: str) -> str:
+    """Drop this process's join claim and return its id.
+
+    The claim is ``joins/join-<host>-<pid>.json`` — content-addressed
+    by claimant identity, so a retried write is idempotent and a
+    duplicate file left by a torn retry dedupes in ``pending_joins``.
+    Runs under the process retry policy at fault site ``elastic.join``
+    (torn/duplicate/failed claim writes are injectable and retried
+    with deterministic backoff).
+    """
+    joins = _joins_dir(elastic_dir)
+    os.makedirs(joins, exist_ok=True)
+    host = socket.gethostname() or "host"
+    jid = f"{host}-{os.getpid()}"
+    path = os.path.join(joins, f"join-{jid}.json")
+
+    def _claim():
+        _write_json(path, {"id": jid, "host": host, "pid": os.getpid()})
+        # Fired AFTER the write so a torn/rank_join fault can hit the
+        # claim file itself; an ioerror after the (idempotent) write
+        # still exercises the backoff-retry-rewrite path.
+        faults.fire("elastic.join", path=path)
+
+    faults.retry(_claim, "elastic.join", transient=(OSError,))
+    logging.warning(f"ELASTIC: join claim {jid} dropped in {joins}")
+    return jid
+
+
+def pending_joins(elastic_dir: str) -> List[str]:
+    """Join-claim ids not yet answered by an admit/decline marker.
+
+    Duplicate claim files for one claimant (a retried write that left
+    two files behind) dedupe by the id INSIDE the claim, not the
+    filename.  A torn/unreadable claim is skipped loudly — the
+    joiner's retry policy rewrites it, or the joiner times out."""
+    joins = _joins_dir(elastic_dir)
+    try:
+        names = os.listdir(joins)
+    except OSError:
+        return []
+    ids = set()
+    for name in sorted(names):
+        if not (name.startswith("join-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(joins, name)) as f:
+                ids.add(str(json.load(f)["id"]))
+        except (OSError, ValueError, KeyError):
+            logging.warning(
+                f"ELASTIC: skipping unreadable join claim {name} "
+                "(torn write? the claimant's retry rewrites it)")
+    return [jid for jid in sorted(ids)
+            if not os.path.exists(os.path.join(joins,
+                                               f"admit-{jid}.json"))
+            and not os.path.exists(os.path.join(joins,
+                                                f"decline-{jid}.json"))]
+
+
+def evaluate_join_policy(live_world: int, join_ids: List[str],
+                         target: str, min_world: int):
+    """The autoscaling decision, as a pure function so every rank and
+    every test computes the same verdict from the same inputs.
+
+    ``target`` is ``capacity`` (admit every claim — scale to whatever
+    shows up) or ``fixed:N`` (admit only up to a world of N).  A batch
+    whose admission would still leave the world below ``min_world`` is
+    declined whole: a reconfigure window is not worth paying for a
+    world that stays under the floor.  Returns ``(admit, declined)``
+    where ``declined`` is ``[(id, reason), ...]``; both orderings are
+    deterministic (sorted ids), so coordinator-assigned new ranks are
+    reproducible."""
+    ids = sorted(join_ids)
+    declined = []
+    if target == "capacity":
+        admit = ids
+    elif target.startswith("fixed:"):
+        try:
+            cap = int(target[len("fixed:"):])
+        except ValueError:
+            raise ValueError(
+                f"--elastic-target {target!r}: expected 'capacity' or "
+                "'fixed:<N>'")
+        if cap < 1:
+            raise ValueError(f"--elastic-target {target!r}: N must be "
+                             ">= 1")
+        room = max(0, cap - live_world)
+        admit = ids[:room]
+        declined = [(jid, f"world already at fixed target {cap} "
+                          f"(live {live_world})") for jid in ids[room:]]
+    else:
+        raise ValueError(
+            f"--elastic-target {target!r}: expected 'capacity' or "
+            "'fixed:<N>'")
+    if admit and live_world + len(admit) < min_world:
+        declined += [(jid, f"grown world {live_world + len(admit)} "
+                           f"would stay below --elastic-min-world "
+                           f"{min_world}") for jid in admit]
+        admit = []
+    return admit, declined
+
+
+def scan_joins(elastic_dir: str, live_world: int, target: str,
+               min_world: int):
+    """Health-boundary poll: pending claims put through the admission
+    policy.  Returns ``(admit, declined)`` like evaluate_join_policy."""
+    return evaluate_join_policy(live_world, pending_joins(elastic_dir),
+                                target, min_world)
+
+
+def decline_joins(elastic_dir: str, declined, gen: int) -> None:
+    """Answer declined claims with marker files (idempotent) so their
+    claimants stop waiting.  Only the main rank / coordinator writes
+    these — one authoritative verdict per claim."""
+    joins = _joins_dir(elastic_dir)
+    os.makedirs(joins, exist_ok=True)
+    for jid, reason in declined:
+        path = os.path.join(joins, f"decline-{jid}.json")
+        if os.path.exists(path):
+            continue
+        _write_json(path, {"id": jid, "reason": reason,
+                           "generation": gen})
+        logging.warning(f"ELASTIC: declined join {jid}: {reason}")
+
+
+def wait_for_admission(elastic_dir: str, jid: str,
+                       timeout_s: Optional[float] = None) -> dict:
+    """Joiner side: poll for the coordinator's verdict on my claim.
+    Returns the admit doc (generation, new_rank, new_world,
+    coordinator, members, joiners); raises JoinDeclinedError on a
+    decline marker, TimeoutError when no verdict lands in time (no
+    --elastic run reaching health boundaries on this dir, or the claim
+    arrived after the run ended)."""
+    joins = _joins_dir(elastic_dir)
+    wait_s = JOIN_WAIT_S if timeout_s is None else timeout_s
+    deadline = time.monotonic() + wait_s
+    while time.monotonic() < deadline:
+        for name, is_decline in ((f"admit-{jid}.json", False),
+                                 (f"decline-{jid}.json", True)):
+            path = os.path.join(joins, name)
+            if not os.path.exists(path):
+                continue
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue  # mid-replace read; retry
+            if is_decline:
+                raise JoinDeclinedError(
+                    f"elastic join {jid} declined: "
+                    f"{doc.get('reason', 'unspecified')}")
+            return doc
+        time.sleep(0.2)
+    raise TimeoutError(
+        f"elastic join {jid}: no admit/decline marker within "
+        f"{wait_s:.0f}s — is an --elastic run reaching health "
+        f"boundaries on {elastic_dir}?")
+
+
+def join_world(elastic_dir: str,
+               timeout_s: Optional[float] = None) -> dict:
+    """A joining process's whole entry: claim, wait for the verdict,
+    connect to the published world.  Returns the same shape as
+    ``reconfigure`` (generation/members/joiners/coordinator/new_rank/
+    new_world).  The collective connect runs under the retry policy at
+    fault site ``elastic.grow_reinit`` — the joiner can race the new
+    coordinator's service coming up, exactly like a shrink follower.
+
+    A joiner is never the coordinator (survivors elect among
+    themselves; its new rank starts past the member list), so there is
+    no service to host and nothing parked before the init — failures
+    before a successful connect raise normally."""
+    global _generation, _reconfigured, _barrier
+    jid = request_join(elastic_dir)
+    doc = wait_for_admission(elastic_dir, jid, timeout_s)
+    gen = int(doc["generation"])
+    new_rank = int(doc["new_rank"])
+    new_world = int(doc["new_world"])
+    logging.warning(
+        f"ELASTIC: join {jid} admitted into generation {gen} as rank "
+        f"{new_rank} of {new_world} (coordinator {doc['coordinator']})")
+
+    def _reinit():
+        faults.fire("elastic.grow_reinit")
+        manual_init(doc["coordinator"], new_world, new_rank)
+
+    faults.retry(_reinit, "elastic.grow_reinit",
+                 transient=(OSError, TimeoutError, RuntimeError))
+    # Drop anything jax memoized before the distributed init (a local
+    # backend built during warm-up imports would otherwise shadow the
+    # collective one).
+    _clear_backend_caches()
+    members = sorted(doc.get("members", []))
+    joiners = list(doc.get("joiners", []))
+    _barrier = {"dir": _gen_dir(elastic_dir, gen), "me": f"join-{jid}",
+                "peers": [str(m) for m in members]
+                + [f"join-{j}" for j in joiners if j != jid]}
+    _generation = gen
+    _reconfigured = True
+    return {"generation": gen, "members": members, "joiners": joiners,
+            "coordinator": doc["coordinator"], "new_rank": new_rank,
+            "new_world": new_world}
+
+
 def _rendezvous(elastic_dir: str, gen: int, old_rank: int,
-                old_world: int) -> dict:
+                old_world: int, grow: bool = False,
+                target: str = "capacity", min_world: int = 1) -> dict:
     """One claim/elect/publish round.  Returns the world.json doc:
-    ``{"generation": g, "members": [old ranks...], "coordinator": addr}``.
+    ``{"generation": g, "members": [old ranks...], "joiners": [ids...],
+    "coordinator": addr}``.
 
     Every survivor: write my claim, wait for the claim set to settle
     (no new claim for SETTLE_S).  Lowest claimed old rank: self-elect,
@@ -464,6 +711,16 @@ def _rendezvous(elastic_dir: str, gen: int, old_rank: int,
     world.json, check membership.  A straggler that claims after the
     settle window missed the generation — it finds itself absent from
     ``members`` and fails loudly rather than wedging the new world.
+
+    A GROW round differs in three ways: the full old world claims (so
+    the every-rank-claimed refusal is suppressed), completion means
+    all old ranks plus at least one pending join claim, and the
+    coordinator re-runs the admission policy at publish time — its
+    verdict is authoritative — publishing the admitted ids as
+    ``joiners`` and answering each with an ``admit-<id>.json`` marker
+    carrying the joiner's new rank, while declined claims get decline
+    markers.  Joiner ranks are assigned past the member list in
+    sorted-id order, so every rank derives the same world layout.
     """
     gen_dir = _gen_dir(elastic_dir, gen)
     os.makedirs(gen_dir, exist_ok=True)
@@ -481,28 +738,48 @@ def _rendezvous(elastic_dir: str, gen: int, old_rank: int,
         if now_claimed != members:
             members = now_claimed
             last_change = time.monotonic()
-        # Fast path for the common case, exactly one rank lost: once
-        # every other old rank has claimed, there is no one left to
-        # wait for — publish immediately instead of sitting out the
-        # settle window (which exists to cover multi-loss, where the
-        # claim set can't tell us when it is complete).
-        complete = len(members) == old_world - 1
+        # Fast path for the common cases — exactly one rank lost, or a
+        # grow where everyone is still alive: once every expected old
+        # rank has claimed (and, growing, at least one join claim is
+        # visible), there is no one left to wait for — publish
+        # immediately instead of sitting out the settle window (which
+        # exists to cover multi-loss, where the claim set can't tell
+        # us when it is complete).
+        complete = len(members) == (old_world if grow
+                                    else old_world - 1)
+        if grow:
+            complete = complete and bool(pending_joins(elastic_dir))
         settled = complete \
             or (time.monotonic() - last_change) >= SETTLE_S
         # The settle window can only end the wait for the would-be
         # coordinator; followers keep polling for world.json so a
         # slow-to-settle coordinator doesn't strand them.
         if settled and members and members[0] == old_rank:
-            if len(members) >= old_world:
+            if len(members) >= old_world and not grow:
                 raise RuntimeError(
                     "elastic rendezvous: every rank of the old world "
                     f"claimed generation {gen} ({members}) — nothing "
                     "actually died; refusing to reconfigure")
+            joiners: List[str] = []
+            if grow:
+                joiners, declined = evaluate_join_policy(
+                    len(members), pending_joins(elastic_dir), target,
+                    min_world)
+                decline_joins(elastic_dir, declined, gen)
             host = os.environ.get("JAX_ELASTIC_HOST", "localhost")
             address = f"{host}:{_free_port()}"
             doc = {"generation": gen, "members": members,
-                   "coordinator": address}
+                   "joiners": joiners, "coordinator": address}
             _write_json(world_path, doc)
+            for i, jid in enumerate(joiners):
+                _write_json(
+                    os.path.join(_joins_dir(elastic_dir),
+                                 f"admit-{jid}.json"),
+                    {"id": jid, "generation": gen,
+                     "new_rank": len(members) + i,
+                     "new_world": len(members) + len(joiners),
+                     "coordinator": address, "members": members,
+                     "joiners": joiners})
             return doc
         time.sleep(0.2)
 
@@ -529,19 +806,25 @@ def _rendezvous(elastic_dir: str, gen: int, old_rank: int,
         f"within {WORLD_WAIT_S}s — coordinator candidate lost?")
 
 
-def reconfigure(elastic_dir: str, old_rank: int, old_world: int) -> dict:
-    """Tear down the failed generation and join the shrunken one.
+def reconfigure(elastic_dir: str, old_rank: int, old_world: int,
+                grow: bool = False, target: str = "capacity",
+                min_world: int = 1) -> dict:
+    """Tear down the current generation and join the reconfigured one —
+    shrunken after a peer loss, or grown (``grow=True``) after the
+    health boundary agreed to admit join claims.
 
-    Returns ``{"generation", "members", "coordinator", "new_rank",
-    "new_world"}``.  The collective-runtime re-init (the transient-
-    failure-prone part: a follower can race the new coordinator's
-    service coming up) runs under the process retry policy at fault
-    site ``elastic.reinit``.
+    Returns ``{"generation", "members", "joiners", "coordinator",
+    "new_rank", "new_world"}``.  The collective-runtime re-init (the
+    transient-failure-prone part: a follower can race the new
+    coordinator's service coming up) runs under the process retry
+    policy at fault site ``elastic.reinit`` (``elastic.grow_reinit``
+    when growing).
     """
     global _generation, _reconfigured, _barrier
     gen = _generation + 1
     logging.warning(
-        f"ELASTIC: rank {old_rank} reconfiguring from world size "
+        f"ELASTIC: rank {old_rank} reconfiguring "
+        f"({'grow' if grow else 'shrink'}) from world size "
         f"{old_world} (generation {gen})")
     # Tear the failed generation down BEFORE the rendezvous: closing
     # our gloo sockets is the wake-up signal for any peer still
@@ -557,19 +840,22 @@ def reconfigure(elastic_dir: str, old_rank: int, old_world: int) -> dict:
         _clear_backend_caches()
         gc.collect()
         _close_stale_collective_sockets()
-        doc = _rendezvous(elastic_dir, gen, old_rank, old_world)
+        doc = _rendezvous(elastic_dir, gen, old_rank, old_world,
+                          grow=grow, target=target, min_world=min_world)
         members = sorted(doc["members"])
+        joiners = list(doc.get("joiners", []))
         new_rank = members.index(old_rank)
-        new_world = len(members)
+        new_world = len(members) + len(joiners)
+        site = "elastic.grow_reinit" if grow else "elastic.reinit"
 
         def _reinit():
-            faults.fire("elastic.reinit")
+            faults.fire(site)
             manual_init(doc["coordinator"], new_world, new_rank)
 
         # RuntimeError covers a failed/timed-out connect to a
         # coordinator service that isn't up yet — same classification
         # as runtime.init.
-        faults.retry(_reinit, "elastic.reinit",
+        faults.retry(_reinit, site,
                      transient=(OSError, TimeoutError, RuntimeError))
         # Again, post-reinit: drop anything rebuilt against the blank
         # interregnum global state while the rendezvous was running.
@@ -585,15 +871,19 @@ def reconfigure(elastic_dir: str, old_rank: int, old_world: int) -> dict:
             f"{gen}; exiting", exc_info=True)
         quiesce_exit(1)
 
+    # Barrier tokens: members keep their old-rank integer (marker
+    # filenames unchanged from the shrink-only protocol); joiners are
+    # addressed by claim id — join_world writes the matching token.
     _barrier = {"dir": _gen_dir(elastic_dir, gen), "me": old_rank,
-                "peers": [m for m in members if m != old_rank]}
+                "peers": [m for m in members if m != old_rank]
+                + [f"join-{j}" for j in joiners]}
     _generation = gen
     _reconfigured = True
     logging.warning(
         f"ELASTIC: generation {gen} up — old rank {old_rank} is now "
         f"rank {new_rank} of {new_world} "
-        f"(coordinator {doc['coordinator']})")
-    return {"generation": gen, "members": members,
+        f"({len(joiners)} joined; coordinator {doc['coordinator']})")
+    return {"generation": gen, "members": members, "joiners": joiners,
             "coordinator": doc["coordinator"], "new_rank": new_rank,
             "new_world": new_world}
 

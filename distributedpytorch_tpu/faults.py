@@ -14,6 +14,10 @@ named sites threaded through the runtime.  Sites currently wired:
   ckpt.restore     checkpoint.py read/restore
   runtime.init     runtime.py jax.distributed.initialize
   elastic.reinit   elastic.py shrunken-world re-initialization
+  elastic.join     elastic.py join-claim write (grow rendezvous entry;
+                   receives the claim path — torn/rank_join apply)
+  elastic.grow_reinit  elastic.py grown-world re-initialization (both
+                   the joiner's connect and the survivors' grow reinit)
   telemetry.write  telemetry.py JSONL writer
 
 Plan forms (``--fault-plan``):
@@ -42,7 +46,11 @@ scripts/anomaly_gate.py), ``rank_loss`` (``os._exit(113)`` — the
 process vanishes mid-collective with no cleanup, no SIGTERM handler,
 no flushed buffers: the shape of a preempted/oom-killed host its
 peers must detect and survive; this is how the elastic reconfigure
-path is proven, see scripts/chaos_gate.py --stage elastic).
+path is proven, see scripts/chaos_gate.py --stage elastic),
+``rank_join`` (drop a DUPLICATE of the join claim at ``path`` — the
+shape of a joiner that retried its claim write after a partition and
+left two files behind; only meaningful at elastic.join, where the
+rendezvous must dedupe claims by claimant identity, not filename).
 
 Every firing emits a ``fault_injected`` telemetry event and a flight-
 recorder event (flightrec.py), so chaos runs are auditable from the
@@ -80,11 +88,12 @@ from . import flightrec, goodput, telemetry
 
 T = TypeVar("T")
 
-KINDS = ("ioerror", "fatal", "preempt", "torn", "stall", "rank_loss")
+KINDS = ("ioerror", "fatal", "preempt", "torn", "stall", "rank_loss",
+         "rank_join")
 
 SITES = ("data.read", "data.host_batch", "ckpt.save", "ckpt.finalize",
          "ckpt.restore", "runtime.init", "elastic.reinit",
-         "telemetry.write")
+         "elastic.join", "elastic.grow_reinit", "telemetry.write")
 
 # Exit code of a rank killed by kind=rank_loss: distinguishable in the
 # harness from a crash (1), a fatal-agreement exit (CHILD_EXIT) and a
@@ -247,6 +256,25 @@ class FaultPlan:
             os._exit(RANK_LOSS_EXIT)
         if spec.kind == "torn":
             _tear(path)
+            return
+        if spec.kind == "rank_join":
+            _duplicate_claim(path)
+
+
+def _duplicate_claim(path: Optional[str]) -> None:
+    """Simulate a joiner whose claim write was retried across a
+    partition and left TWO files behind: copy the claim at ``path`` to
+    a sibling ``*-dup.json`` and let the site carry on.  The grow
+    rendezvous must dedupe by the claimant id inside the claim, so the
+    duplicate admits exactly one rank, not two."""
+    if path is None or not os.path.exists(path):
+        logging.warning(f"rank_join fault: no claim to duplicate at "
+                        f"{path!r}")
+        return
+    dup = (path[:-len(".json")] if path.endswith(".json") else path) \
+        + "-dup.json"
+    with open(path, "rb") as src, open(dup, "wb") as dst:
+        dst.write(src.read())
 
 
 def _tear(path: Optional[str]) -> None:

@@ -138,6 +138,34 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     _initialized = True
 
 
+def join_distributed(elastic_dir: str,
+                     timeout_s: Optional[float] = None) -> dict:
+    """Enter an already-running ``--elastic`` world as a joiner.
+
+    The grow-side counterpart of ``initialize_distributed``: instead of
+    standing up a world from coordinator/num_processes/process_id, this
+    process drops a join claim in the shared rendezvous dir, waits for
+    the running world's coordinator to admit it at a health boundary,
+    and connects at the rank the admit marker assigns
+    (elastic.join_world; the connect itself runs under fault site
+    ``elastic.grow_reinit``).  Returns the join info dict — the caller
+    emits the telemetry, since the joiner's rank is only known now.
+    """
+    global _initialized
+    if _initialized:
+        raise RuntimeError("join_distributed: the distributed runtime "
+                           "is already initialized in this process")
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # older/newer jax without the option
+        pass
+    from . import elastic as elastic_mod
+
+    info = elastic_mod.join_world(elastic_dir, timeout_s)
+    _initialized = True
+    return info
+
+
 def process_index() -> int:
     """Global rank of this host process (ref: firstLocalRank+gpu, classif.py:82)."""
     return jax.process_index()
@@ -231,8 +259,10 @@ def any_process(flag: bool) -> bool:
 
 
 def agree_health(failed: bool, shutdown: bool,
-                 timeout_s: Optional[float] = None) -> tuple:
-    """(any_failed, any_shutdown) across every process — ONE allgather.
+                 timeout_s: Optional[float] = None,
+                 grow: bool = False) -> tuple:
+    """(any_failed, any_shutdown, any_grow) across every process — ONE
+    allgather.
 
     The failure-agreement extension of ``any_process``: a rank that hit
     a fatal error at a loop boundary reports ``failed=True`` here
@@ -255,17 +285,26 @@ def agree_health(failed: bool, shutdown: bool,
     safe preemption, and the gloo transport either errors it out
     promptly or the process is about to exit/reinit anyway.
 
-    Folding both flags into one message keeps the collective schedule
-    identical to the old single-flag health check (no extra rendezvous
-    per boundary).  Single-process: no communication.
+    ``grow`` is the elastic scale-UP vote: a rank that saw an
+    admissible join claim in the rendezvous dir reports it here, so
+    every survivor agrees to reconfigure into the larger world at the
+    SAME boundary — the same agreement discipline that keeps failure
+    exits aligned.  Filesystem polling is racy across ranks (one rank
+    can list the claim before its peers); the OR over the allgather is
+    exactly the repair: one vote is enough, and the rendezvous
+    coordinator re-checks the claims authoritatively.
+
+    Folding all three flags into one message keeps the collective
+    schedule identical to the old single-flag health check (no extra
+    rendezvous per boundary).  Single-process: no communication.
     """
     if jax.process_count() == 1:
-        return bool(failed), bool(shutdown)
+        return bool(failed), bool(shutdown), bool(grow)
     from jax.experimental import multihost_utils
 
     def _gather():
         return multihost_utils.process_allgather(
-            np.array([failed, shutdown], dtype=bool))
+            np.array([failed, shutdown, grow], dtype=bool))
 
     if timeout_s is None or timeout_s <= 0:
         flags = _gather()
@@ -289,7 +328,8 @@ def agree_health(failed: bool, shutdown: bool,
         if "error" in box:
             raise box["error"]
         flags = box["flags"]
-    return bool(np.any(flags[..., 0])), bool(np.any(flags[..., 1]))
+    return (bool(np.any(flags[..., 0])), bool(np.any(flags[..., 1])),
+            bool(np.any(flags[..., 2])))
 
 
 _cache_hits = 0

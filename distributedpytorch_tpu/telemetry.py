@@ -527,23 +527,44 @@ def render_report(agg: Dict[str, Any]) -> str:
     expected = max((int(e.get("attrs", {}).get("processes", 0))
                     for e in agg["events"]
                     if e.get("name") == "run_start"), default=0)
+    # A mid-run joiner announces itself with elastic/join: its stream
+    # starting late (or reusing a departed rank's file) is by design.
+    joined = sorted({int(e["attrs"]["new_rank"]) for e in agg["events"]
+                     if e.get("name") == "elastic/join"
+                     and isinstance(e.get("attrs"), dict)
+                     and isinstance(e["attrs"].get("new_rank"), int)})
+    if joined:
+        lines.append(f"note: rank(s) {joined} joined mid-run in an "
+                     f"elastic grow; their streams starting late is "
+                     f"expected")
     if expected > len(agg["ranks"]):
         missing = sorted(set(range(expected)) - set(agg["ranks"]))
-        # An elastic run loses ranks by design: every survivor emits an
-        # elastic/reconfigure event carrying the shrunken new_world.
-        # Missing rank slots at/above the smallest surviving world
-        # departed in a reconfigure — a note, not a writer failure;
-        # anything below it really is a lost/disabled writer.
-        worlds = [int(e["attrs"]["new_world"]) for e in agg["events"]
-                  if e.get("name") == "elastic/reconfigure"
-                  and isinstance(e.get("attrs"), dict)
-                  and isinstance(e["attrs"].get("new_world"), int)]
-        final_world = min(worlds) if worlds else expected
+        # An elastic run changes membership by design: every member
+        # emits an elastic/reconfigure (and a joiner an elastic/join)
+        # event carrying its generation's new_world.  The current
+        # world is the NEWEST generation's size — not the minimum over
+        # the run, which a shrink-then-grow history would underread,
+        # mislabeling readmitted rank slots as departed.  Missing rank
+        # slots at/above the current world departed in a reconfigure —
+        # a note, not a writer failure; anything below it really is a
+        # lost/disabled writer.
+        gens: Dict[int, int] = {}
+        for e in agg["events"]:
+            if e.get("name") not in ("elastic/reconfigure",
+                                     "elastic/join"):
+                continue
+            attrs = e.get("attrs")
+            if not isinstance(attrs, dict):
+                continue
+            g, w = attrs.get("generation"), attrs.get("new_world")
+            if isinstance(g, int) and isinstance(w, int):
+                gens[g] = w
+        final_world = gens[max(gens)] if gens else expected
         departed = [r for r in missing if r >= final_world]
         missing = [r for r in missing if r < final_world]
         if departed:
             lines.append(f"note: rank(s) {departed} departed in an "
-                         f"elastic reconfigure (world shrank to "
+                         f"elastic reconfigure (world now "
                          f"{final_world}); their files ending early — "
                          f"or never landing — is expected, not loss")
         if missing:

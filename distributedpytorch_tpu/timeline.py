@@ -31,6 +31,15 @@ rank-loss shape — degrades only itself, and an ``elastic/reconfigure``
 boundary in the events is surfaced as a survivors/departed warning
 rather than a crash or silent truncation.
 
+Grown worlds.  A rank that JOINS mid-run (elastic grow) announces
+itself with an ``elastic/join`` event — and when it is a departed rank
+restarting, it appends to the departed incarnation's telemetry file.
+The two incarnations have different mono origins, so alignment cuts at
+the join instant: boundary offsets use only post-join boundaries (the
+joined rank aligns from its first health-boundary), and the pre-join
+segment is re-anchored by its own wall clock with a warning.  The
+reconfigure warning names joined ranks alongside departed ones.
+
 Skew report.  At every shared boundary the ranks' *wall* stamps should
 agree too; their spread (max - min) is the measured cross-rank wall-clock
 skew per epoch, reported per boundary and as a maximum.  The straggler
@@ -88,11 +97,15 @@ def _goodput_rows(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
     return rows
 
 
-def _boundaries(events: List[Dict[str, Any]]
+def _boundaries(events: List[Dict[str, Any]],
+                cuts: Optional[Dict[int, float]] = None
                 ) -> Dict[int, Dict[int, Dict[str, float]]]:
     """rank -> epoch -> {"ts","mono"} for every health_boundary event.
     A rank that emitted the same epoch twice keeps the last stamp (a
-    resumed run re-walks earlier epochs)."""
+    resumed run re-walks earlier epochs).  ``cuts`` (rank -> wall ts of
+    its last ``elastic/join``) drops boundaries stamped BEFORE a rank
+    rejoined: those belong to the departed incarnation, whose mono
+    origin is unrelated to the rejoined process's."""
     out: Dict[int, Dict[int, Dict[str, float]]] = {}
     for ev in events:
         if ev.get("kind") != "event" or ev.get("name") != "health_boundary":
@@ -103,21 +116,46 @@ def _boundaries(events: List[Dict[str, Any]]
             stamp = {"ts": float(ev["ts"]), "mono": float(ev["mono"])}
         except (KeyError, TypeError, ValueError):
             continue
+        if cuts and stamp["ts"] < cuts.get(rank, float("-inf")):
+            continue
         out.setdefault(rank, {})[epoch] = stamp
     return out
 
 
-def _wall_delta(events: List[Dict[str, Any]], rank: int) -> Optional[float]:
+def _join_cuts(events: List[Dict[str, Any]]) -> Dict[int, float]:
+    """rank -> wall ts of that rank's LAST ``elastic/join`` event: the
+    instant a mid-run joiner's stream (re)started.  A rejoining rank
+    appends to the departed incarnation's telemetry file, so records
+    before the cut carry a different mono origin than records after."""
+    cuts: Dict[int, float] = {}
+    for ev in events:
+        if ev.get("kind") != "event" or ev.get("name") != "elastic/join":
+            continue
+        rank, ts = ev.get("rank"), ev.get("ts")
+        if isinstance(rank, int) and isinstance(ts, (int, float)):
+            cuts[rank] = max(float(ts), cuts.get(rank, float("-inf")))
+    return cuts
+
+
+def _wall_delta(events: List[Dict[str, Any]], rank: int,
+                lo: Optional[float] = None,
+                hi: Optional[float] = None) -> Optional[float]:
     """Median ``ts - mono`` for one rank: maps its mono clock onto its
-    own wall clock (the no-boundary fallback alignment)."""
+    own wall clock (the no-boundary fallback alignment).  ``lo``/``hi``
+    bound the wall stamps considered — used to keep a rejoined rank's
+    two incarnations (different mono origins) from polluting each
+    other's delta."""
     deltas = [float(ev["ts"]) - float(ev["mono"]) for ev in events
               if ev.get("rank") == rank
               and isinstance(ev.get("ts"), (int, float))
-              and isinstance(ev.get("mono"), (int, float))]
+              and isinstance(ev.get("mono"), (int, float))
+              and (lo is None or float(ev["ts"]) >= lo)
+              and (hi is None or float(ev["ts"]) < hi)]
     return statistics.median(deltas) if deltas else None
 
 
-def _alignment(events: List[Dict[str, Any]], ranks: List[int]
+def _alignment(events: List[Dict[str, Any]], ranks: List[int],
+               cuts: Optional[Dict[int, float]] = None
                ) -> Tuple[Dict[int, float], str, List[str]]:
     """Per-rank offset to add to that rank's mono stamps so all ranks
     share one time axis.  Returns (offsets, method, warnings).
@@ -132,9 +170,14 @@ def _alignment(events: List[Dict[str, Any]], ranks: List[int]
     every offset targets the WALL axis (boundary offsets are shifted by
     the base rank's own ts-mono delta) so the two kinds of offset land
     on one comparable axis.
+
+    A rank with a join cut (see :func:`_join_cuts`) aligns from its
+    first POST-join health boundary; its pre-join segment gets a
+    separate wall-clock offset in :func:`build_timeline`.
     """
+    cuts = cuts or {}
     warnings: List[str] = []
-    bounds = _boundaries(events)
+    bounds = _boundaries(events, cuts)
     base = min(ranks)
     boundary_offsets: Dict[int, float] = {}
     fallback: List[int] = []
@@ -162,12 +205,12 @@ def _alignment(events: List[Dict[str, Any]], ranks: List[int]
                     f"health_boundary with rank {base} (stream "
                     "truncated before its first boundary?); aligning "
                     "it by wall clock only")
-            base_delta = _wall_delta(events, base)
+            base_delta = _wall_delta(events, base, lo=cuts.get(base))
             if base_delta is not None:
                 offsets = {r: off + base_delta
                            for r, off in boundary_offsets.items()}
                 for r in fallback:
-                    d = _wall_delta(events, r)
+                    d = _wall_delta(events, r, lo=cuts.get(r))
                     offsets[r] = d if d is not None else base_delta
                 return offsets, "mixed", warnings
             # base has no usable ts/mono pairs at all — degenerate;
@@ -179,7 +222,7 @@ def _alignment(events: List[Dict[str, Any]], ranks: List[int]
     # to host clock skew (single-rank runs trivially so).
     offsets = {}
     for r in ranks:
-        d = _wall_delta(events, r)
+        d = _wall_delta(events, r, lo=cuts.get(r))
         offsets[r] = d if d is not None else 0.0
     return offsets, "wall_clock", warnings
 
@@ -255,7 +298,35 @@ def build_timeline(rsl_path: str) -> Dict[str, Any]:
         raise ValueError(
             f"telemetry under {rsl_path!r} has no rank-stamped events; "
             "was it produced by an older build? re-run with --telemetry")
-    offsets, method, warnings = _alignment(events, ranks)
+    cuts = _join_cuts(events)
+    offsets, method, warnings = _alignment(events, ranks, cuts)
+    # A rejoined rank's pre-join segment (the departed incarnation's
+    # records, same file, different mono origin) gets its own offset:
+    # its own wall clock, shifted onto whatever axis `offsets` targets.
+    pre_offsets: Dict[int, float] = {}
+    if cuts:
+        base = min(ranks)
+        base_delta = (_wall_delta(events, base, lo=cuts.get(base))
+                      if method == "health_boundary" else None)
+        for r, cut in sorted(cuts.items()):
+            pre_delta = _wall_delta(events, r, hi=cut)
+            if pre_delta is None:
+                continue  # fresh joiner: no pre-join records at all
+            if method == "health_boundary":
+                if base_delta is None:
+                    warnings.append(
+                        f"clock alignment: rank {r} rejoined mid-run but "
+                        f"base rank {base} has no usable wall stamps; its "
+                        "pre-join segment may be misplaced")
+                    continue
+                pre_offsets[r] = (pre_delta - base_delta
+                                  + offsets.get(base, 0.0))
+            else:  # mixed / wall_clock: offsets already target wall time
+                pre_offsets[r] = pre_delta
+            warnings.append(
+                f"clock alignment: rank {r} rejoined mid-run (elastic "
+                "grow); its pre-join segment is aligned by wall clock "
+                "only")
     for r in ranks:
         if r not in dumps:
             warnings.append(f"no flight record for rank {r} "
@@ -276,17 +347,29 @@ def build_timeline(rsl_path: str) -> Dict[str, Any]:
               and isinstance(ev.get("rank"), int)]
     if reconf:
         survivors = sorted({int(ev["rank"]) for ev in reconf})
-        departed = sorted(set(ranks) - set(survivors))
+        joined = sorted(set(cuts) & set(ranks))
+        departed = sorted(set(ranks) - set(survivors) - set(joined))
         gens = sorted({_attrs(ev).get("generation") for ev in reconf
                        if _attrs(ev).get("generation") is not None})
-        warnings.append(
-            f"elastic reconfigure (generation(s) {gens}): survivors "
-            f"{survivors} continued in a smaller world"
-            + (f"; rank(s) {departed} departed — their streams "
-               "truncate at the failure, which is expected, not data "
-               "loss" if departed else ""))
+        dep_note = (f"; rank(s) {departed} departed — their streams "
+                    "truncate at the failure, which is expected, not "
+                    "data loss" if departed else "")
+        if joined:
+            warnings.append(
+                f"elastic reconfigure (generation(s) {gens}): survivors "
+                f"{survivors} continued across the world change(s); "
+                f"rank(s) {joined} joined in a grow generation — their "
+                "streams begin (or restart) mid-run" + dep_note)
+        else:
+            warnings.append(
+                f"elastic reconfigure (generation(s) {gens}): survivors "
+                f"{survivors} continued in a smaller world" + dep_note)
 
-    def aligned(rank: int, mono: float) -> float:
+    def aligned(rank: int, mono: float,
+                ts: Optional[float] = None) -> float:
+        if ts is not None and rank in pre_offsets \
+                and ts < cuts.get(rank, float("-inf")):
+            return mono + pre_offsets[rank]
         return mono + offsets.get(rank, 0.0)
 
     # First pass: the trace origin is the earliest aligned stamp so every
@@ -295,7 +378,9 @@ def build_timeline(rsl_path: str) -> Dict[str, Any]:
     for ev in events:
         if isinstance(ev.get("mono"), (int, float)) \
                 and isinstance(ev.get("rank"), int):
-            t = aligned(ev["rank"], float(ev["mono"]))
+            wall = (float(ev["ts"])
+                    if isinstance(ev.get("ts"), (int, float)) else None)
+            t = aligned(ev["rank"], float(ev["mono"]), wall)
             if ev.get("kind") == "span" \
                     and isinstance(ev.get("dur_s"), (int, float)):
                 t -= float(ev["dur_s"])  # span stamps are END stamps
@@ -317,8 +402,8 @@ def build_timeline(rsl_path: str) -> Dict[str, Any]:
             f"no timestamped records under {rsl_path!r}; nothing to plot")
     origin = min(stamps)
 
-    def us(rank: int, mono: float) -> float:
-        return round((aligned(rank, float(mono)) - origin) * 1e6, 3)
+    def us(rank: int, mono: float, ts: Optional[float] = None) -> float:
+        return round((aligned(rank, float(mono), ts) - origin) * 1e6, 3)
 
     trace_events: List[Dict[str, Any]] = []
     for r in ranks:
@@ -342,20 +427,23 @@ def build_timeline(rsl_path: str) -> Dict[str, Any]:
         if not isinstance(r, int) or not isinstance(mono, (int, float)):
             continue
         kind = ev.get("kind")
+        wall = (float(ev["ts"])
+                if isinstance(ev.get("ts"), (int, float)) else None)
         if kind == "span" and isinstance(ev.get("dur_s"), (int, float)):
             dur = float(ev["dur_s"])
             trace_events.append({
                 "ph": "X", "cat": "telemetry",
                 "name": str(ev.get("name", "span")), "pid": r,
                 "tid": _TID_SPANS,
-                "ts": us(r, float(mono) - dur), "dur": round(dur * 1e6, 3),
+                "ts": us(r, float(mono) - dur, wall),
+                "dur": round(dur * 1e6, 3),
                 "args": _attrs(ev),
             })
         elif kind == "event":
             trace_events.append({
                 "ph": "i", "cat": "telemetry", "s": "p",
                 "name": str(ev.get("name", "event")), "pid": r,
-                "tid": _TID_EVENTS, "ts": us(r, mono),
+                "tid": _TID_EVENTS, "ts": us(r, mono, wall),
                 "args": _attrs(ev),
             })
     for r, doc in dumps.items():

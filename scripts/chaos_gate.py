@@ -28,10 +28,22 @@ Four stages, all CPU and bounded:
      shared run dir: ``elastic/reconfigure`` in both survivors'
      JSONLs, flight dumps carrying reason ``reconfigure``, rank 2
      exiting with the rank-loss status.
+  F. grow (``--stage grow``, its own gate.sh leg) — stage E's shrink,
+     then the scale-UP half: once the driver observes the shrink-to-2
+     reconfigure in rank 0's JSONL, it launches a FOURTH process with
+     ``--elastic-join``.  The joiner drops a join claim, the survivors
+     admit it at the next health boundary and grow back to a 3-world,
+     everyone resumes from the newest 2-world snapshot, and all of
+     ranks 0/1/joiner finish and exit 0 (original rank 2 exits with
+     the rank-loss status).  The grown world's final checkpoint must
+     equal (allclose) an uninterrupted 3-rank reference run resumed
+     from a copy of that same snapshot — proving restore-into-a-
+     larger-mesh and the N+1 loader re-derivation end to end.
 
 Run as ``env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py``
-(stages A-D) or with ``--stage elastic`` (stage E only).  The script
-re-execs itself with ``--child`` for the multi-process stages' ranks.
+(stages A-D) or with ``--stage elastic`` / ``--stage grow`` (one stage
+each).  The script re-execs itself with ``--child`` for the
+multi-process stages' ranks.
 """
 
 import argparse
@@ -115,6 +127,17 @@ def main(stage: str = "core") -> int:
             return 1
         print("chaos gate OK: rank loss survived, world shrunk, resumed "
               "run matches the uninterrupted reference")
+        return 0
+
+    if stage == "grow":
+        problems = _stage_grow(work)
+        for p in problems:
+            print(f"PROBLEM: {p}", file=sys.stderr)
+        if problems:
+            return 1
+        print("chaos gate OK: world shrank on rank loss, grew back on "
+              "the rejoin, and the grown world matches the "
+              "uninterrupted 3-rank reference")
         return 0
 
     # -- stage A: fault-free reference --------------------------------
@@ -254,25 +277,30 @@ def _stage_fatal_agreement(work: str, plan_dir: str) -> list:
     return problems
 
 
-def _spawn_world(work: str, tag: str, nprocs: int, rsls: list,
-                 plan: str = None, elastic: bool = False,
-                 epochs: int = 2, ckpt_file: str = None,
-                 stream: bool = False) -> list:
+def _child_env() -> dict:
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _launch_world(work: str, tag: str, nprocs: int, rsls: list,
+                  plan: str = None, elastic: bool = False,
+                  epochs: int = 2, ckpt_file: str = None,
+                  stream: bool = False) -> list:
     """Spawn ``nprocs`` ranks of this script as real processes over a
-    gloo rendezvous; return [(rank, rc-or-None, logpath)] once all exit
-    or the shared deadline lapses (hung ranks are killed, rc None)."""
+    gloo rendezvous; return [(rank, Popen, logpath)] WITHOUT waiting —
+    the grow stage needs to act (launch a joiner) while the world
+    runs."""
     import socket
 
     with socket.socket() as s:
         s.bind(("localhost", 0))
         coord = f"localhost:{s.getsockname()[1]}"
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    procs, logs = [], []
+    env = _child_env()
+    procs = []
     for pid in range(nprocs):
         log = os.path.join(work, f"{tag}_rank{pid}.log")
-        logs.append(log)
         argv = [sys.executable, os.path.abspath(__file__), "--child",
                 "--coord", coord, "--pid", str(pid),
                 "--nprocs", str(nprocs), "--epochs", str(epochs),
@@ -287,18 +315,35 @@ def _spawn_world(work: str, tag: str, nprocs: int, rsls: list,
             argv += ["--stream"]
         # A log FILE, never a pipe (see _stage_fatal_agreement).
         out = open(log, "ab")
-        procs.append(subprocess.Popen(argv, cwd=REPO, env=env,
-                                      stdout=out, stderr=out))
+        procs.append((pid, subprocess.Popen(argv, cwd=REPO, env=env,
+                                            stdout=out, stderr=out),
+                      log))
+    return procs
+
+
+def _await_world(procs: list) -> list:
+    """[(rank, Popen, log)] -> [(rank, rc-or-None, log)] once all exit
+    or the shared deadline lapses (hung ranks are killed, rc None)."""
     deadline = time.monotonic() + CHILD_DEADLINE_S
     results = []
-    for pid, p in enumerate(procs):
+    for pid, p, log in procs:
         try:
             rc = p.wait(timeout=max(1.0, deadline - time.monotonic()))
         except subprocess.TimeoutExpired:
             p.kill()
             rc = None
-        results.append((pid, rc, logs[pid]))
+        results.append((pid, rc, log))
     return results
+
+
+def _spawn_world(work: str, tag: str, nprocs: int, rsls: list,
+                 plan: str = None, elastic: bool = False,
+                 epochs: int = 2, ckpt_file: str = None,
+                 stream: bool = False) -> list:
+    """_launch_world + _await_world, for the stages that just block."""
+    return _await_world(_launch_world(
+        work, tag, nprocs, rsls, plan=plan, elastic=elastic,
+        epochs=epochs, ckpt_file=ckpt_file, stream=stream))
 
 
 def _ckpt_state_leaves(path: str) -> list:
@@ -425,6 +470,189 @@ def _stage_elastic(work: str) -> list:
     return problems
 
 
+GROW_EPOCHS = 5
+SHRINK_WAIT_S = 240.0
+
+
+def _wait_for_event(rsl: str, rank: int, name: str, pred,
+                    timeout_s: float) -> dict:
+    """Poll one rank's JSONL until an event matching ``pred`` lands (the
+    writers flush elastic events eagerly) or the timeout lapses."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            evs = [e for e in _named(_events(rsl, rank=rank), name)
+                   if pred(e)]
+        except (OSError, ValueError):
+            evs = []  # file not there yet, or a line torn mid-write
+        if evs:
+            return evs[0]
+        time.sleep(1.0)
+    return None
+
+
+def _launch_joiner(work: str, tag: str, rsl: str, epochs: int):
+    """Spawn one ``--elastic-join`` process against a live run's dir.
+    No coordinator address and no nprocs: the joiner discovers the
+    world through the join claim protocol, nothing else."""
+    log = os.path.join(work, f"{tag}_joiner.log")
+    argv = [sys.executable, os.path.abspath(__file__), "--child",
+            "--join", "--pid", "3", "--epochs", str(epochs),
+            "--rsl", rsl, "--stream"]
+    out = open(log, "ab")
+    return (3, subprocess.Popen(argv, cwd=REPO, env=_child_env(),
+                                stdout=out, stderr=out), log)
+
+
+def _stage_grow(work: str) -> list:
+    """Stage F driver: stage E's rank loss, then scale back UP.  A
+    3-rank elastic world loses rank 2 and shrinks to 2; the driver
+    watches rank 0's JSONL for the shrink reconfigure, then launches a
+    fourth process with --elastic-join.  The survivors must admit it at
+    a health boundary, grow back to a 3-world and resume from the
+    newest 2-world snapshot — and the grown world's final params must
+    equal an uninterrupted 3-rank reference resumed from a copy of that
+    same snapshot."""
+    import shutil
+
+    import numpy as np
+
+    from distributedpytorch_tpu import checkpoint as ckpt
+    from distributedpytorch_tpu.faults import RANK_LOSS_EXIT
+
+    problems = []
+    rsl_a = os.path.join(work, "grow")
+    os.makedirs(rsl_a, exist_ok=True)
+    # Hit math: same as stage E (rank 2 dies on host-batch hit 41 —
+    # train step 7 of epoch 1).  The stall spec is the timing knob: a
+    # stall is a pure sleep (numerics untouched), so slowing rank 0's
+    # post-loss host batches by 0.25s each holds the shrunken world
+    # open long enough for the driver to observe the shrink and for
+    # the joiner's claim to land before the run ends.
+    plan_path = os.path.join(work, "grow_plan.json")
+    with open(plan_path, "w") as f:
+        json.dump({"faults": [
+            {"site": "data.host_batch", "kind": "rank_loss",
+             "after_n": 40, "count": 1, "rank": 2},
+            {"site": "data.host_batch", "kind": "stall", "after_n": 34,
+             "count": 250, "stall_s": 0.25, "rank": 0},
+        ]}, f)
+    procs = _launch_world(work, "grow", nprocs=3, rsls=[rsl_a] * 3,
+                          plan=plan_path, elastic=True,
+                          epochs=GROW_EPOCHS, stream=True)
+    # Wait for the shrink BEFORE dropping the join claim: a claim
+    # visible while rank 2 is still alive would grow the world to 4.
+    shrunk = _wait_for_event(
+        rsl_a, 0, "elastic/reconfigure",
+        lambda e: e.get("attrs", {}).get("new_world") == 2,
+        timeout_s=SHRINK_WAIT_S)
+    if shrunk is None:
+        for _, p, _ in procs:
+            p.kill()
+        return [f"grow stage: no shrink-to-2 reconfigure on rank 0 "
+                f"within {SHRINK_WAIT_S:.0f}s\n{_tail(procs[0][2])}"]
+    print("chaos gate F: shrink observed, launching the joiner")
+    results = _await_world(procs + [_launch_joiner(work, "grow", rsl_a,
+                                                   GROW_EPOCHS)])
+    for pid, rc, log in results:
+        want = RANK_LOSS_EXIT if pid == 2 else 0
+        label = ("rank-loss exit" if pid == 2
+                 else "survived the shrink-then-grow")
+        if rc is None:
+            problems.append(f"grow rank {pid} HUNG past "
+                            f"{CHILD_DEADLINE_S:.0f}s\n{_tail(log)}")
+        elif rc != want:
+            problems.append(f"grow rank {pid} exited rc={rc}, expected "
+                            f"{want} ({label})\n{_tail(log)}")
+    if problems:
+        return problems
+
+    # Survivors' trail: shrink to 2 then grow to 3, in order, with the
+    # grow reconfigure naming the joiner.
+    for pid in (0, 1):
+        try:
+            evs = _named(_events(rsl_a, rank=pid), "elastic/reconfigure")
+        except OSError:
+            evs = []
+        worlds = [e.get("attrs", {}).get("new_world") for e in evs]
+        if worlds != [2, 3]:
+            problems.append(f"survivor rank {pid} reconfigure worlds "
+                            f"{worlds}, expected [2, 3]")
+        elif not (evs[1]["attrs"].get("grow")
+                  and evs[1]["attrs"].get("joined")):
+            problems.append(f"survivor rank {pid} grow reconfigure "
+                            f"lacks grow/joined attrs: "
+                            f"{evs[1]['attrs']}")
+    # The joiner took over rank 2's slot (and telemetry file, opened in
+    # append): its birth certificate is the elastic/join event.
+    try:
+        joins = _named(_events(rsl_a, rank=2), "elastic/join")
+    except OSError:
+        joins = []
+    if not joins or joins[0]["attrs"].get("new_world") != 3 \
+            or joins[0]["attrs"].get("new_rank") != 2:
+        problems.append(
+            "no elastic/join event in the rejoined rank-2 stream "
+            f"(got {[e.get('attrs') for e in joins]})")
+    # Where did the grown world resume?  Generation 1 was the shrink,
+    # generation 2 the grow; its elastic/resume names the start epoch.
+    resumes = [e for e in _named(_events(rsl_a, rank=0), "elastic/resume")
+               if e.get("attrs", {}).get("generation") == 2]
+    if not resumes:
+        return problems + ["no generation-2 elastic/resume event on "
+                           "rank 0 — cannot locate the grow resume "
+                           "point"]
+    e_r = resumes[0]["attrs"].get("epoch")
+    if not isinstance(e_r, int) or not 1 <= e_r < GROW_EPOCHS:
+        return problems + [f"grow resume epoch {e_r!r} outside "
+                           f"[1, {GROW_EPOCHS})"]
+    if problems:
+        return problems
+
+    # Reference: an uninterrupted 3-rank world resumed from a copy of
+    # the very snapshot the grown world restored — written by the
+    # 2-world at epoch e_r - 1.  From e_r on, run A is a 3-world too,
+    # with resharded loaders and a restored-into-a-larger-mesh state;
+    # determinism makes the final params exactly comparable.
+    snap = ckpt.checkpoint_path(rsl_a, "synthetic", "mlp", e_r - 1)
+    if not os.path.exists(snap):
+        return problems + [f"grow resume snapshot {snap} missing"]
+    rsl_b = os.path.join(work, "grow_ref")
+    os.makedirs(rsl_b, exist_ok=True)
+    ref0 = ckpt.checkpoint_path(rsl_b, "synthetic", "mlp", e_r - 1)
+    shutil.copy2(snap, ref0)
+    results = _spawn_world(work, "grow_ref", nprocs=3, rsls=[rsl_b] * 3,
+                           epochs=GROW_EPOCHS, ckpt_file=ref0,
+                           stream=True)
+    for pid, rc, log in results:
+        if rc != 0:
+            problems.append(f"grow reference rank {pid} exited rc={rc}, "
+                            f"expected 0\n{_tail(log)}")
+    if problems:
+        return problems
+    final_a = ckpt.checkpoint_path(rsl_a, "synthetic", "mlp",
+                                   GROW_EPOCHS - 1)
+    final_b = ckpt.checkpoint_path(rsl_b, "synthetic", "mlp",
+                                   GROW_EPOCHS - 1)
+    for path, who in ((final_a, "grown world"), (final_b, "reference")):
+        if not os.path.exists(path):
+            problems.append(f"{who} wrote no final checkpoint {path}")
+    if problems:
+        return problems
+    pa, pb = _ckpt_state_leaves(final_a), _ckpt_state_leaves(final_b)
+    if len(pa) != len(pb) or not all(
+            np.allclose(a, b, rtol=1e-5, atol=1e-6)
+            for a, b in zip(pa, pb)):
+        problems.append("grown world's final params differ from the "
+                        "uninterrupted 3-rank reference — the rejoin "
+                        "did not recover bit-compatibly")
+    if not problems:
+        print(f"chaos gate F: shrank to 2 on the rank loss, grew back "
+              f"to 3 on the rejoin (resumed at epoch {e_r}), matched "
+              f"the reference")
+    return problems
+
+
 def _tail(path: str, n: int = 2500) -> str:
     try:
         return open(path).read()[-n:]
@@ -447,16 +675,24 @@ def child_main(a) -> int:
     from distributedpytorch_tpu import elastic, faults, runtime
     from distributedpytorch_tpu.cli import run_train
 
-    runtime.initialize_distributed(coordinator_address=a.coord,
-                                   num_processes=a.nprocs,
-                                   process_id=a.pid, elastic=a.elastic)
+    if not a.join:
+        # A joiner never dials the old coordinator: run_train routes it
+        # through the join-claim protocol (runtime.join_distributed).
+        runtime.initialize_distributed(coordinator_address=a.coord,
+                                       num_processes=a.nprocs,
+                                       process_id=a.pid,
+                                       elastic=a.elastic)
     cfg = _base_cfg(a.rsl).replace(
         fault_plan=a.plan, nb_epochs=a.epochs, batch_size=4,
-        checkpoint_file=a.ckpt, elastic=a.elastic,
-        health_timeout=20.0 if a.elastic else 0.0,
-        # stage E streams: data.host_batch (the rank_loss site) is only
-        # live on the streamed path, and reshard-on-shrink is the
-        # ShardedLoader contract under proof here
+        checkpoint_file=a.ckpt, elastic=a.elastic or a.join,
+        elastic_join=a.join,
+        # stage F resumes from mid-run snapshots the driver picks after
+        # the fact: keep every epoch's file out of rotation's reach
+        keep_ckpts=a.epochs,
+        health_timeout=20.0 if (a.elastic or a.join) else 0.0,
+        # stages E/F stream: data.host_batch (the rank_loss site) is
+        # only live on the streamed path, and reshard-on-shrink/grow is
+        # the ShardedLoader contract under proof here
         data_mode="stream" if a.stream else "auto")
     try:
         run_train(cfg)
@@ -473,9 +709,10 @@ def child_main(a) -> int:
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
-    ap.add_argument("--stage", choices=("core", "elastic"),
+    ap.add_argument("--stage", choices=("core", "elastic", "grow"),
                     default="core")
     ap.add_argument("--child", action="store_true")
+    ap.add_argument("--join", action="store_true")
     ap.add_argument("--coord")
     ap.add_argument("--pid", type=int)
     ap.add_argument("--nprocs", type=int, default=2)

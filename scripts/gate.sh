@@ -39,17 +39,23 @@
 #      snapshot, and finish with params allclose-identical to an
 #      uninterrupted 2-rank reference — see scripts/chaos_gate.py
 #      --stage elastic and README "Elastic training"
-#   9. roofline gate: a profiled 2-epoch CPU run must attribute >=90%
+#   9. grow gate: stage 8's shrink, then scale-UP — a fourth process
+#      with --elastic-join rejoins the shrunken world; survivors must
+#      grow back to 3, resume from the newest 2-world snapshot, and
+#      finish with params allclose-identical to an uninterrupted
+#      3-rank reference — see scripts/chaos_gate.py --stage grow and
+#      README "Elastic training"
+#  10. roofline gate: a profiled 2-epoch CPU run must attribute >=90%
 #      of traced device step time to named ops, classify every op
 #      compute- vs memory-bound, and round-trip through
 #      ``main.py roofline`` (incl. --from-anomaly) — see
 #      scripts/roofline_gate.py and README "Roofline attribution &
 #      bench trends"
-#  10. bench-trend gate: the committed BENCH_r*.json history must pass
+#  11. bench-trend gate: the committed BENCH_r*.json history must pass
 #      its own regression ledger — deltas only between fresh rows,
 #      latest fresh-vs-fresh delta within threshold — see
 #      scripts/bench_trend.py
-#  11. the driver's own gate: __graft_entry__.dryrun_multichip(8)
+#  12. the driver's own gate: __graft_entry__.dryrun_multichip(8)
 #      (clean env, exactly as the driver runs it)
 #
 # Tier map:
@@ -102,6 +108,9 @@ env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/goodput_gate.py
 
 echo "== gate: elastic (rank loss / shrink / resume parity) =="
 env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py --stage elastic
+
+echo "== gate: grow (rejoin / scale-up / resume parity) =="
+env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py --stage grow
 
 echo "== gate: roofline (per-op attribution / bound classes) =="
 env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/roofline_gate.py

@@ -1,10 +1,14 @@
 """Elastic world manager (elastic.py, --elastic): filesystem-rendezvous
 election without any live collectives, peer-loss classification, the
-bounded health agreement (--health-timeout), and the shrunken-world
-re-derivation property — a world-(N-1) loader enumerates exactly the
-full dataset, identically whether re-derived via ``reshard`` or born at
-that size.  The end-to-end proof (a real rank vanishing mid-epoch over
-gloo) lives in ``scripts/chaos_gate.py --stage elastic``.
+bounded health agreement (--health-timeout), and the world
+re-derivation property in BOTH directions — a world-(N±1) loader
+enumerates exactly the full dataset, identically whether re-derived
+via ``reshard`` or born at that size.  The grow half: join claims,
+the admission policy (--elastic-target / --elastic-min-world), the
+grow rendezvous publishing admit/decline markers, and the
+restore-into-a-larger-mesh round trip.  The end-to-end proofs (a real
+rank vanishing mid-epoch over gloo; a shrink-then-grow rejoin) live in
+``scripts/chaos_gate.py --stage elastic`` / ``--stage grow``.
 """
 
 import json
@@ -16,12 +20,16 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh
 
+from distributedpytorch_tpu import checkpoint as ckpt
 from distributedpytorch_tpu import elastic, faults, runtime
 from distributedpytorch_tpu.config import config_from_argv
 from distributedpytorch_tpu.data.datasets import Split
 from distributedpytorch_tpu.data.pipeline import ShardedLoader
 from distributedpytorch_tpu.data.sampler import ShardedSampler
+from distributedpytorch_tpu.models import get_model
+from distributedpytorch_tpu.ops.losses import get_loss_fn
 from distributedpytorch_tpu.runtime import DATA_AXIS
+from distributedpytorch_tpu.train.engine import Engine, make_optimizer
 
 
 @pytest.fixture(autouse=True)
@@ -155,14 +163,31 @@ def test_agree_health_timeout_path_returns_flags(monkeypatch):
     monkeypatch.setattr(jax, "process_count", lambda: 2)
     monkeypatch.setattr(
         multihost_utils, "process_allgather",
-        lambda arr: np.array([[False, True], [False, False]]))
+        lambda arr: np.array([[False, True, False],
+                              [False, False, False]]))
     assert runtime.agree_health(False, True, timeout_s=5.0) \
-        == (False, True)
+        == (False, True, False)
+
+
+def test_agree_health_gathers_peer_grow_vote(monkeypatch):
+    # One rank saw a join claim (filesystem polling races are OR-repaired
+    # by the vote): EVERY rank must come out agreeing to grow.
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda arr: np.array([[False, False, True],
+                              [False, False, False]]))
+    assert runtime.agree_health(False, False, timeout_s=5.0,
+                                grow=False) == (False, False, True)
 
 
 def test_agree_health_single_process_short_circuits():
     assert runtime.agree_health(True, False, timeout_s=0.001) \
-        == (True, False)
+        == (True, False, False)
+    assert runtime.agree_health(False, False, grow=True) \
+        == (False, False, True)
 
 
 # -- flags + module state ---------------------------------------------
@@ -271,3 +296,224 @@ def test_reshard_covers_dataset_via_valid_mask():
         # pixel // 4 recovers the sample index
         seen.extend((img[v][:, 0, 0] // 4).tolist())
     assert sorted(seen) == list(range(50))
+
+
+# -- join claims + admission policy (grow) ----------------------------
+
+def _join_claim(elastic_dir, jid: str) -> None:
+    joins = elastic._joins_dir(str(elastic_dir))
+    os.makedirs(joins, exist_ok=True)
+    with open(os.path.join(joins, f"join-{jid}.json"), "w") as f:
+        json.dump({"id": jid, "host": "h", "pid": 1}, f)
+
+
+def test_request_join_roundtrips_through_pending(tmp_path):
+    jid = elastic.request_join(str(tmp_path))
+    assert elastic.pending_joins(str(tmp_path)) == [jid]
+
+
+def test_duplicate_claim_files_dedupe_by_inner_id(tmp_path):
+    # A torn retry can leave TWO files for one claimant; admission must
+    # count the claimant once (dedupe by the id INSIDE the claim).
+    _join_claim(tmp_path, "h-1")
+    joins = elastic._joins_dir(str(tmp_path))
+    with open(os.path.join(joins, "join-h-1-dup.json"), "w") as f:
+        json.dump({"id": "h-1", "host": "h", "pid": 1}, f)
+    assert elastic.pending_joins(str(tmp_path)) == ["h-1"]
+
+
+def test_rank_join_fault_injects_duplicate_claim(tmp_path):
+    # The injectable shape behind the test above: the rank_join kind at
+    # site elastic.join copies the freshly written claim to a sibling.
+    faults.install(faults.parse_plan("elastic.join:rank_join:0:1"))
+    try:
+        jid = elastic.request_join(str(tmp_path))
+        joins = elastic._joins_dir(str(tmp_path))
+        claims = [n for n in os.listdir(joins) if n.startswith("join-")]
+        assert len(claims) == 2  # the claim and its injected duplicate
+        assert elastic.pending_joins(str(tmp_path)) == [jid]
+    finally:
+        faults.install(None)
+
+
+def test_torn_join_claim_is_skipped_loudly(tmp_path):
+    joins = elastic._joins_dir(str(tmp_path))
+    os.makedirs(joins)
+    with open(os.path.join(joins, "join-h-2.json"), "w") as f:
+        f.write('{"id": "h-')  # torn mid-write
+    assert elastic.pending_joins(str(tmp_path)) == []
+
+
+def test_answered_claims_leave_pending(tmp_path):
+    _join_claim(tmp_path, "h-1")
+    _join_claim(tmp_path, "h-2")
+    elastic.decline_joins(str(tmp_path), [("h-1", "over target")], gen=1)
+    assert elastic.pending_joins(str(tmp_path)) == ["h-2"]
+
+
+def test_join_policy_capacity_admits_all():
+    admit, declined = elastic.evaluate_join_policy(
+        2, ["b", "a"], "capacity", 1)
+    assert admit == ["a", "b"] and declined == []
+
+
+def test_join_policy_fixed_target_caps_admissions():
+    admit, declined = elastic.evaluate_join_policy(
+        2, ["a", "b", "c"], "fixed:4", 1)
+    assert admit == ["a", "b"]
+    assert [jid for jid, _ in declined] == ["c"]
+    assert "fixed target 4" in declined[0][1]
+
+
+def test_join_policy_declines_whole_batch_below_min_world():
+    admit, declined = elastic.evaluate_join_policy(
+        1, ["a", "b"], "capacity", 5)
+    assert admit == []
+    assert sorted(jid for jid, _ in declined) == ["a", "b"]
+    assert "--elastic-min-world 5" in declined[0][1]
+
+
+def test_join_policy_rejects_junk_target():
+    with pytest.raises(ValueError, match="elastic-target"):
+        elastic.evaluate_join_policy(1, [], "bogus", 1)
+    with pytest.raises(ValueError, match="N must be"):
+        elastic.evaluate_join_policy(1, [], "fixed:0", 1)
+
+
+def test_wait_for_admission_decline_raises(tmp_path):
+    elastic.decline_joins(str(tmp_path), [("h-9", "below the floor")],
+                          gen=2)
+    with pytest.raises(elastic.JoinDeclinedError, match="below the floor"):
+        elastic.wait_for_admission(str(tmp_path), "h-9", timeout_s=2.0)
+
+
+def test_late_joiner_times_out_loudly(tmp_path):
+    # A claim dropped after the run ended (or with no --elastic run on
+    # this dir at all) must fail bounded, not wait forever.
+    with pytest.raises(TimeoutError, match="no admit/decline"):
+        elastic.wait_for_admission(str(tmp_path), "h-9", timeout_s=0.3)
+
+
+# -- grow rendezvous --------------------------------------------------
+
+def test_grow_rendezvous_publishes_joiners_and_admit_marker(
+        tmp_path, fast_settle):
+    # Old world 2 fully alive (grow suppresses the nothing-died refusal)
+    # plus one pending join: the coordinator publishes the joiner and
+    # answers its claim with an admit marker carrying rank 2 of 3.
+    _claim(str(tmp_path / "gen-1"), 1)
+    _join_claim(tmp_path, "hostx-77")
+    doc = elastic._rendezvous(str(tmp_path), gen=1, old_rank=0,
+                              old_world=2, grow=True)
+    assert doc["members"] == [0, 1]
+    assert doc["joiners"] == ["hostx-77"]
+    with open(os.path.join(elastic._joins_dir(str(tmp_path)),
+                           "admit-hostx-77.json")) as f:
+        admit = json.load(f)
+    assert admit["generation"] == 1
+    assert admit["new_rank"] == 2 and admit["new_world"] == 3
+    assert admit["coordinator"] == doc["coordinator"]
+    # The claim is now answered: no longer pending for later boundaries.
+    assert elastic.pending_joins(str(tmp_path)) == []
+
+
+def test_grow_rendezvous_declines_over_fixed_target(tmp_path,
+                                                    fast_settle):
+    # fixed:2 with a live world of 2: the claim gets a decline marker,
+    # the published world is the identity (safe fallback, no new ranks).
+    _claim(str(tmp_path / "gen-1"), 1)
+    _join_claim(tmp_path, "hostx-88")
+    doc = elastic._rendezvous(str(tmp_path), gen=1, old_rank=0,
+                              old_world=2, grow=True, target="fixed:2")
+    assert doc["members"] == [0, 1] and doc["joiners"] == []
+    with open(os.path.join(elastic._joins_dir(str(tmp_path)),
+                           "decline-hostx-88.json")) as f:
+        assert "fixed target 2" in json.load(f)["reason"]
+
+
+# -- grown-world re-derivation property -------------------------------
+
+@pytest.mark.parametrize("num_samples", [37, 101, 200])
+@pytest.mark.parametrize("world", [1, 2, 3])
+def test_grown_world_covers_dataset_exactly(num_samples, world):
+    # The N-1 exact-once property generalizes to N+1: after a grow the
+    # resumed samplers at world+1 cover every sample exactly once per
+    # epoch — no duplicates from the wraparound padding, no drops.
+    for epoch in (0, 1, 5):
+        grown = _covered(num_samples, world + 1, batch=4, epoch=epoch)
+        assert sorted(grown) == list(range(num_samples))
+
+
+def test_reshard_up_equals_loader_born_at_larger_world():
+    split = Split(
+        images=np.arange(37 * 4, dtype=np.uint8).reshape(37, 2, 2),
+        labels=np.arange(37, dtype=np.int32) % 10)
+    old = ShardedLoader(split, _data_mesh(2), batch_per_replica=4,
+                        shuffle=True, seed=5)
+    fresh = ShardedLoader(split, _data_mesh(3), batch_per_replica=4,
+                          shuffle=True, seed=5)
+    grown = old.reshard(_data_mesh(3))
+    assert grown.world == 3
+    assert grown.batches_per_epoch == fresh.batches_per_epoch
+    for epoch in (0, 1):
+        for (ai, al, av), (bi, bl, bv) in zip(grown.epoch(epoch),
+                                              fresh.epoch(epoch)):
+            np.testing.assert_array_equal(np.asarray(ai),
+                                          np.asarray(bi))
+            np.testing.assert_array_equal(np.asarray(al),
+                                          np.asarray(bl))
+            np.testing.assert_array_equal(np.asarray(av),
+                                          np.asarray(bv))
+
+
+# -- restore into a larger mesh ---------------------------------------
+
+@pytest.fixture(scope="module")
+def mlp_state():
+    model = get_model("mlp", 10, half_precision=False)
+    tx = make_optimizer("adam", 1e-3, 0.9, 0.1, 4, False)
+    engine = Engine(model, "mlp", get_loss_fn("cross_entropy"), tx,
+                    mean=0.45, std=0.2, input_size=28,
+                    half_precision=False)
+    return engine, engine.init_state(jax.random.PRNGKey(7))
+
+
+def test_checkpoint_restores_into_larger_mesh(tmp_path, mlp_state):
+    # Shrink-then-grow resume: a snapshot saved from a 2-device mesh
+    # restores into a 3-device mesh bit-identically — checkpoints are
+    # replicated host state, so world size is not part of the format.
+    engine, state = mlp_state
+    placed = jax.device_put(state,
+                            runtime.replicated_sharding(_data_mesh(2)))
+    path = ckpt.checkpoint_path(str(tmp_path), "synthetic", "mlp", 3)
+    ckpt.save_checkpoint(path, "mlp", placed, 3, 0.25)
+
+    template = engine.init_state(jax.random.PRNGKey(99))  # differs
+    restored, start_epoch, best = ckpt.load_checkpoint_with_fallback(
+        path, template, str(tmp_path), "synthetic", "mlp")
+    restored = jax.device_put(
+        restored, runtime.replicated_sharding(_data_mesh(3)))
+    assert start_epoch == 4 and best == 0.25
+    saved_leaves = jax.tree_util.tree_leaves(placed.params)
+    got_leaves = jax.tree_util.tree_leaves(restored.params)
+    assert len(saved_leaves) == len(got_leaves)
+    for a, b in zip(saved_leaves, got_leaves):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- grow flags -------------------------------------------------------
+
+def test_grow_flags_parse():
+    cfg = config_from_argv(["train", "-d", "/nodata", "--elastic",
+                            "--elastic-join",
+                            "--elastic-target", "fixed:4",
+                            "--elastic-min-world", "2"])
+    assert cfg.elastic_join and cfg.elastic_target == "fixed:4"
+    assert cfg.elastic_min_world == 2
+
+
+def test_grow_flags_default_off():
+    cfg = config_from_argv(["train", "-d", "/nodata"])
+    assert not cfg.elastic_join
+    assert cfg.elastic_target == "capacity"
+    assert cfg.elastic_min_world == 1

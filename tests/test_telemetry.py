@@ -376,3 +376,58 @@ def test_report_notes_departed_rank_instead_of_warning():
     report = telemetry.render_report(telemetry.aggregate(base))
     assert "rank(s) [2] departed in an elastic reconfigure" in report
     assert "rank(s) [1] skipped" in report
+
+
+def test_report_across_shrink_then_grow_history():
+    """Shrink to 2 then grow back to 3: the rejoined rank appearing
+    mid-run must trip NEITHER the missing-rank WARNING nor the departed
+    note (the current world is the NEWEST generation's size, not the
+    minimum over the run), and counters still sum exactly once."""
+    events = [{"kind": "event", "name": "run_start", "rank": r,
+               "ts": 1.0, "attrs": {"processes": 3}} for r in range(3)]
+    events += [{"kind": "counter", "name": "data/batches", "rank": r,
+                "ts": 2.0, "value": 10.0} for r in range(3)]
+    # rank 2 dies; survivors shrink to a 2-world...
+    events += [{"kind": "event", "name": "elastic/reconfigure",
+                "rank": r, "ts": 3.0,
+                "attrs": {"generation": 1, "old_world": 3,
+                          "new_world": 2, "old_rank": r, "new_rank": r}}
+               for r in range(2)]
+    # ...then it rejoins: survivors reconfigure to 3, the joiner
+    # announces itself (appending to the departed incarnation's file).
+    events += [{"kind": "event", "name": "elastic/reconfigure",
+                "rank": r, "ts": 4.0,
+                "attrs": {"generation": 2, "old_world": 2,
+                          "new_world": 3, "old_rank": r, "new_rank": r,
+                          "grow": True}} for r in range(2)]
+    events += [{"kind": "event", "name": "elastic/join", "rank": 2,
+                "ts": 4.0, "attrs": {"generation": 2, "new_world": 3,
+                                     "new_rank": 2}}]
+    events += [{"kind": "counter", "name": "data/batches", "rank": r,
+                "ts": 5.0, "value": 5.0} for r in range(3)]
+    agg = telemetry.aggregate(events)
+    assert agg["ranks"] == [0, 1, 2]
+    assert agg["counters"]["data/batches"] == pytest.approx(45.0)
+    report = telemetry.render_report(agg)
+    assert "rank(s) [2] joined mid-run in an elastic grow" in report
+    assert "departed in an elastic reconfigure" not in report
+    assert "skipped (telemetry writer" not in report
+
+
+def test_report_grown_world_still_warns_on_lost_writer():
+    """After a grow to world 3, a missing rank BELOW the final world is
+    still a real lost-writer WARNING — the grow must not blanket-excuse
+    missing files."""
+    events = [{"kind": "event", "name": "run_start", "rank": 0,
+               "ts": 1.0, "attrs": {"processes": 3}},
+              {"kind": "event", "name": "elastic/reconfigure", "rank": 0,
+               "ts": 2.0, "attrs": {"generation": 1, "old_world": 3,
+                                    "new_world": 2, "old_rank": 0,
+                                    "new_rank": 0}},
+              {"kind": "event", "name": "elastic/reconfigure", "rank": 0,
+               "ts": 3.0, "attrs": {"generation": 2, "old_world": 2,
+                                    "new_world": 3, "old_rank": 0,
+                                    "new_rank": 0, "grow": True}}]
+    report = telemetry.render_report(telemetry.aggregate(events))
+    # ranks 1 and 2 live inside the final 3-world yet left no files
+    assert "rank(s) [1, 2] skipped" in report

@@ -223,6 +223,101 @@ def test_elastic_reconfigure_boundary_is_named(tmp_path):
     assert "not data loss" in named[0]
 
 
+def _stamp2_new(t):
+    """Rank 2's REJOINED incarnation: a fresh process with yet another
+    mono origin (appending to the departed incarnation's file)."""
+    return {"ts": _WALL0 + t, "mono": 20000.0 + t, "rank": 2}
+
+
+def test_grow_names_joined_rank_and_aligns_both_segments(tmp_path):
+    # Shrink-then-grow: rank 2 dies mid-epoch, the survivors shrink
+    # (gen 1) and later admit it back (gen 2).  The rejoined process
+    # appends to rank 2's telemetry file with a NEW mono origin, so the
+    # merger must (a) name the join rather than calling the rank
+    # departed, (b) align the rejoined stream from its first health
+    # boundary, and (c) place the pre-join segment by wall clock
+    # without letting it poison the boundary median.
+    rsl = _two_rank_run(str(tmp_path))
+    # first incarnation: one epoch span, then death (no boundary)
+    _write_rank(rsl, 2, [
+        {"kind": "span", "name": "epoch", "dur_s": 0.9, **_stamp2(1.0)},
+    ])
+    for rank in (0, 1):
+        _write_rank(rsl, rank, [
+            _event(rank, "elastic/reconfigure", 4.5, generation=1,
+                   old_world=3, new_world=2),
+            _event(rank, "elastic/reconfigure", 6.0, generation=2,
+                   old_world=2, new_world=3, grow=True),
+            _event(rank, "health_boundary", 7.0, epoch=2),
+        ])
+    _write_rank(rsl, 2, [
+        {"kind": "event", "name": "elastic/join",
+         "attrs": {"generation": 2, "new_rank": 2, "new_world": 3},
+         **_stamp2_new(6.0)},
+        {"kind": "span", "name": "epoch", "dur_s": 0.9,
+         "attrs": {"epoch": 2}, **_stamp2_new(7.0)},
+        {"kind": "event", "name": "health_boundary",
+         "attrs": {"epoch": 2}, **_stamp2_new(7.0)},
+    ])
+    result = timeline.build_timeline(rsl)
+    # the post-join boundary is shared by all three ranks: precise mode
+    assert result["alignment"] == "health_boundary"
+    named = [w for w in result["warnings"]
+             if "elastic reconfigure" in w]
+    assert len(named) == 1
+    assert "generation(s) [1, 2]" in named[0]
+    assert "survivors [0, 1]" in named[0]
+    assert "rank(s) [2] joined in a grow generation" in named[0]
+    assert "departed" not in named[0]
+    assert any("rank 2 rejoined mid-run" in w and "wall clock" in w
+               for w in result["warnings"])
+    # (b) the rejoined stream aligns from its first boundary: the
+    # epoch-2 boundary instants coincide across ranks 0 and 2 even
+    # though their mono origins are 19000s apart.
+    instants = {e["pid"]: e["ts"]
+                for e in result["trace"]["traceEvents"]
+                if e["ph"] == "i" and e["name"] == "health_boundary"
+                and e["args"].get("epoch") == 2}
+    assert set(instants) == {0, 1, 2}
+    assert instants[2] == pytest.approx(instants[0], abs=1.0)  # µs
+    # (c) the pre-join segment lands at its true physical instant: the
+    # first incarnation's epoch span started at the same moment as
+    # rank 0's epoch-0 span (T=0.1), despite the dead mono origin.
+    rank0_epoch0 = [e for e in result["trace"]["traceEvents"]
+                    if e.get("pid") == 0 and e["ph"] == "X"
+                    and e["name"] == "epoch"
+                    and e["args"].get("epoch") == 0][0]
+    pre_span = min((e for e in result["trace"]["traceEvents"]
+                    if e.get("pid") == 2 and e["ph"] == "X"
+                    and e["name"] == "epoch"), key=lambda e: e["ts"])
+    assert pre_span["ts"] == pytest.approx(rank0_epoch0["ts"], abs=1.0)
+
+
+def test_fresh_joiner_named_without_rejoin_warning(tmp_path):
+    # A NEVER-before-seen rank joining (fresh slot, no pre-join
+    # segment) is named in the reconfigure warning but gets no
+    # wall-clock-only caveat — there is nothing to misalign.
+    rsl = _two_rank_run(str(tmp_path))
+    for rank in (0, 1):
+        _write_rank(rsl, rank, [
+            _event(rank, "elastic/reconfigure", 6.0, generation=1,
+                   old_world=2, new_world=3, grow=True),
+            _event(rank, "health_boundary", 7.0, epoch=2),
+        ])
+    _write_rank(rsl, 2, [
+        {"kind": "event", "name": "elastic/join",
+         "attrs": {"generation": 1, "new_rank": 2, "new_world": 3},
+         **_stamp2_new(6.0)},
+        {"kind": "event", "name": "health_boundary",
+         "attrs": {"epoch": 2}, **_stamp2_new(7.0)},
+    ])
+    result = timeline.build_timeline(rsl)
+    named = [w for w in result["warnings"]
+             if "elastic reconfigure" in w]
+    assert "rank(s) [2] joined in a grow generation" in named[0]
+    assert not any("rejoined mid-run" in w for w in result["warnings"])
+
+
 # -- trace contract ----------------------------------------------------
 
 
