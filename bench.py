@@ -159,7 +159,7 @@ def bench_ours(batch_per_replica: int, steps: int, model_name: str,
                num_train: int = 60000, epochs_fused: int = 12,
                half_precision: bool = True, moe_experts: int = 0,
                pallas_dw: bool = False, precision: str | None = None,
-               remat: str = "none") -> dict:
+               remat: str = "none", scan_layers: bool = False) -> dict:
     import jax
 
     from distributedpytorch_tpu import runtime, utils
@@ -186,7 +186,7 @@ def bench_ours(batch_per_replica: int, steps: int, model_name: str,
     model = get_model(model_name, dataset.nb_classes,
                       precision=policy, remat=remat,
                       moe_experts=moe_experts, mesh=mesh,
-                      pallas_dw=pallas_dw)
+                      pallas_dw=pallas_dw, scan_layers=scan_layers)
     tx = make_optimizer("adam", 1e-3, 0.9, 0.1, len(loader), False)
     engine = Engine(model, model_name, get_loss_fn("cross_entropy"), tx,
                     dataset.mean, dataset.std,
@@ -225,7 +225,16 @@ def bench_ours(batch_per_replica: int, steps: int, model_name: str,
     t0 = time.monotonic()
     compiled = engine.train_epoch.lower(
         state, loader.images, loader.labels, idx, valid, key).compile()
-    log(f"compiled in {time.monotonic() - t0:.1f}s")
+    compile_warmup_s = time.monotonic() - t0
+    log(f"compiled in {compile_warmup_s:.1f}s")
+    # Program size next to the compile time it drives (--scan-layers
+    # exists to shrink both; scan-vs-noscan suite rows difference them).
+    from distributedpytorch_tpu.costs import hlo_instruction_count
+
+    try:
+        hlo_instructions = hlo_instruction_count(compiled.as_text())
+    except Exception:  # HLO text is advisory, backend-dependent
+        hlo_instructions = None
     _force_sync_timing_mode()
 
     def run():
@@ -268,6 +277,9 @@ def bench_ours(batch_per_replica: int, steps: int, model_name: str,
            "samples_per_sec": sps, "samples_per_sec_per_chip": sps / n_chips,
            "n_chips": n_chips, "global_batch": global_batch,
            "steps": n_steps, "elapsed_s": elapsed,
+           "compile_warmup_s": round(compile_warmup_s, 3),
+           "hlo_instructions": hlo_instructions,
+           "scan_layers": scan_layers,
            "device_kind": device_kind, "mfu": None}
     # Honest MFU: the denominator matches the run's compute dtype
     # (ops/flops.py per-dtype peak table), and the row records WHICH
@@ -564,6 +576,17 @@ def run_suite(args) -> dict:
         rows[f"{name}_cifar_b64"] = bench_ours(
             64, args.steps, name, image_size=32, channels=3,
             num_train=n_train, epochs_fused=1)
+    # --scan-layers A/B on the deep-zoo extremes (vit: homogeneous
+    # transformer blocks; densenet: 58 stacked dense layers — the
+    # compile-time worst case).  The interesting columns are
+    # compile_warmup_s and hlo_instructions vs the unrolled row above;
+    # steady-state throughput should hold (scan trades nothing at
+    # runtime) — bench_trend.py differences the pairs.
+    rows["vit_b64_scan"] = bench_ours(64, args.steps, "vit",
+                                      scan_layers=True)
+    rows["densenet_cifar_b64_scan"] = bench_ours(
+        64, args.steps, "densenet", image_size=32, channels=3,
+        num_train=12800, epochs_fused=1, scan_layers=True)
     return rows
 
 

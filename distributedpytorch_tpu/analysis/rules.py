@@ -1337,6 +1337,68 @@ class WallClockInMeasurement(Rule):
                         f"comment why wall time is the point here")
 
 
+# -- 14. blocking-h2d-in-step-loop ------------------------------------
+
+class BlockingH2dInStepLoop(Rule):
+    """A host->device transfer issued inline in the per-step loop is
+    consumed by the very next dispatch, so the H2D copy sits on the
+    critical path instead of overlapping the previous step's compute —
+    the exact gap ``--device-prefetch`` exists to close (the loader's
+    dedicated transfer thread issues sharded ``device_put`` N batches
+    ahead; data/pipeline.py).  Same spirit as host-sync-in-step-loop
+    but for the other direction of the PCIe link.  Applies to the
+    step-driving modules; per-epoch transfers (outside the step loop)
+    are fine.  Deliberate exceptions carry a rationale comment on the
+    line or the line above, same contract as wall-clock-in-measurement.
+    """
+
+    name = "blocking-h2d-in-step-loop"
+    description = ("jax.device_put / make_array_from_process_local_data "
+                   "/ block_until_ready inline in a per-step loop — let "
+                   "the loader's --device-prefetch transfer thread own "
+                   "H2D")
+    TARGET_BASENAMES = {"engine.py", "cli.py"}
+    TRANSFERS = {"device_put", "device_put_sharded",
+                 "device_put_replicated",
+                 "make_array_from_process_local_data"}
+
+    # step-loop iterator shapes are rule 1's, verbatim
+    _is_step_iter = HostSyncInStepLoop._is_step_iter
+
+    def _has_rationale(self, mod: Module, line: int) -> bool:
+        return mod.has_comment(line) or (line - 1) in mod.comment_lines
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            if mod.basename not in self.TARGET_BASENAMES:
+                continue
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.For)
+                        and self._is_step_iter(node.iter)):
+                    continue
+                for stmt in node.body:
+                    for call in walk_calls(stmt):
+                        cn = call_name(call)
+                        seg = last_seg(cn)
+                        if seg in self.TRANSFERS:
+                            what = (f"{cn}() transfers host->device on "
+                                    f"the step's critical path")
+                        elif seg == "block_until_ready":
+                            what = (f"{cn}() stalls the step loop until "
+                                    f"the transfer/step lands")
+                        else:
+                            continue
+                        if self._has_rationale(mod, call.lineno):
+                            continue
+                        yield self.finding(
+                            mod, call.lineno,
+                            f"blocking H2D in per-step loop: {what} — "
+                            f"use the loader's --device-prefetch "
+                            f"transfer thread (or move the transfer "
+                            f"out of the loop), or comment why inline "
+                            f"is the point here")
+
+
 RULES = (
     HostSyncInStepLoop(),
     TraceImpurity(),
@@ -1351,6 +1413,7 @@ RULES = (
     MixedPrecisionAccum(),
     CollectiveInCleanup(),
     WallClockInMeasurement(),
+    BlockingH2dInStepLoop(),
 )
 
 RULES_BY_NAME = {r.name: r for r in RULES}

@@ -171,6 +171,8 @@ def build_trend(bench_dir: Optional[str] = None,
                 row.get("samples_per_sec_per_chip"),
             "mfu": row.get("mfu"),
             "top_ops": row.get("top_ops"),
+            "compile_warmup_s": row.get("compile_warmup_s"),
+            "hlo_instructions": row.get("hlo_instructions"),
         }
     return {
         "schema": SCHEMA,
@@ -183,8 +185,38 @@ def build_trend(bench_dir: Optional[str] = None,
         "regression": regression,
         "ok": not regression,
         "suite": suite_out,
+        "scan_pairs": _scan_pairs(suite_out),
         "notes": notes,
     }
+
+
+def _scan_pairs(suite_out: Dict[str, dict]) -> Dict[str, dict]:
+    """Compile-time deltas for every ``<row>_scan`` / ``<row>`` pair in
+    the suite (the --scan-layers A/B bench.py emits): how much program
+    and compile time the stacked-lax.scan form saves, and whether
+    steady-state throughput held.  Advisory — pairs missing either side
+    are skipped, never an error (older suites predate the scan rows)."""
+    out: Dict[str, dict] = {}
+    for name, row in suite_out.items():
+        if not name.endswith("_scan"):
+            continue
+        base = suite_out.get(name[:-len("_scan")])
+        if not base:
+            continue
+        pair: Dict[str, Any] = {}
+        cs, cb = row.get("compile_warmup_s"), base.get("compile_warmup_s")
+        if cs and cb:
+            pair["compile_speedup"] = cb / cs
+        hs, hb = row.get("hlo_instructions"), base.get("hlo_instructions")
+        if hs and hb:
+            pair["hlo_reduction"] = hb / hs
+        ts = row.get("samples_per_sec_per_chip")
+        tb = base.get("samples_per_sec_per_chip")
+        if ts and tb:
+            pair["throughput_ratio"] = ts / tb
+        if pair:
+            out[name[:-len("_scan")]] = pair
+    return out
 
 
 def render_trend(trend: Dict[str, Any]) -> str:
@@ -220,11 +252,31 @@ def render_trend(trend: Dict[str, Any]) -> str:
             sps_s = f"{sps:,.1f}/chip" if sps is not None else "-"
             mfu_s = f"MFU {row['mfu'] * 100:.1f}%" \
                 if row.get("mfu") is not None else "MFU -"
+            cw = row.get("compile_warmup_s")
+            cw_s = f"  compile {cw:.1f}s" if cw is not None else ""
+            hi = row.get("hlo_instructions")
+            hi_s = f" ({hi} HLO)" if hi is not None else ""
             tops = row.get("top_ops") or []
             top_s = ("; top: " + ", ".join(
                 f"{t['name']} ({t['bound']})" for t in tops[:3]
                 if isinstance(t, dict))) if tops else ""
-            lines.append(f"  {name:<22} {sps_s:>15}  {mfu_s}{top_s}")
+            lines.append(f"  {name:<22} {sps_s:>15}  {mfu_s}"
+                         f"{cw_s}{hi_s}{top_s}")
+    if trend.get("scan_pairs"):
+        lines.append("scan-vs-noscan (--scan-layers A/B, compile-side):")
+        for name, pair in sorted(trend["scan_pairs"].items()):
+            parts = []
+            if "compile_speedup" in pair:
+                parts.append(f"compile {pair['compile_speedup']:.1f}x "
+                             "faster")
+            if "hlo_reduction" in pair:
+                parts.append(f"{pair['hlo_reduction']:.1f}x fewer HLO "
+                             "instructions")
+            if "throughput_ratio" in pair:
+                parts.append("throughput "
+                             f"{pair['throughput_ratio'] * 100:.0f}% of "
+                             "unrolled")
+            lines.append(f"  {name:<22} " + ", ".join(parts))
     lines.append("verdict: " + ("OK — no regression beyond threshold"
                                 if trend["ok"] else
                                 f"REGRESSION — latest delta "

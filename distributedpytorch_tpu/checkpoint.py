@@ -54,7 +54,7 @@ from flax import serialization
 from jax.sharding import NamedSharding, PartitionSpec
 
 from . import faults, goodput, runtime, telemetry
-from .models import vit_pipeline
+from .models import scan as model_scan
 from .train.engine import TrainState
 
 _FORMAT_VERSION = 1
@@ -637,13 +637,14 @@ def _save_orbax(path: str, model_name: str, state: TrainState,
 
 def _orbax_meta(model_name: str, epoch: int, best_valid_loss: float,
                 state_sd: dict) -> dict:
-    # params_layout ('stacked' | 'blocks' | null) lets the loader
-    # restore a pipeline-trained directory into a plain model
-    # (and vice versa) without guessing the on-disk tree shape.
+    # params_layout (vit 'stacked'/'blocks'/'scan', the per-family
+    # '*_scan'/'*_layers' pairs, or null) lets the loader restore a
+    # directory saved under one block layout into a model built with
+    # another without guessing the on-disk tree shape.
     return {"format_version": _FORMAT_VERSION,
             "model_name": model_name, "epoch": int(epoch),
             "loss": float(best_valid_loss),
-            "params_layout": vit_pipeline.params_layout(
+            "params_layout": model_scan.params_layout(
                 state_sd.get("params")),
             # lets the loader refuse a cross-layout restore
             # into/out of a MoE tree with a clear message
@@ -684,7 +685,15 @@ def _has_moe_blocks(params) -> bool:
     if not isinstance(params, dict):
         return False
     blk = params.get("block0")
-    return isinstance(blk, dict) and "moe" in blk
+    if isinstance(blk, dict) and "moe" in blk:
+        return True
+    # scan layout: params/blocks/block holds the stacked body, moe
+    # blocks included (models/scan.py)
+    run = params.get("blocks")
+    if isinstance(run, dict):
+        blk = run.get("block")
+        return isinstance(blk, dict) and "moe" in blk
+    return False
 
 
 def _check_layouts_convertible(path: str, src: str, dst: str,
@@ -795,8 +804,8 @@ def _load_orbax(path: str, state: TrainState, restore_optimizer: bool
     # so the whole target restores replicated (the plain-model
     # ``test -f`` case is replicated anyway).
     src = meta.get("params_layout")
-    dst = vit_pipeline.params_layout(template.get("params"))
-    convert = src in ("stacked", "blocks") and dst is not None \
+    dst = model_scan.params_layout(template.get("params"))
+    convert = src in model_scan.KNOWN_LAYOUTS and dst is not None \
         and src != dst
 
     def leaf_target(x):
@@ -815,7 +824,7 @@ def _load_orbax(path: str, state: TrainState, restore_optimizer: bool
     if convert:
         _check_layouts_convertible(path, src, dst, template.get("params"),
                                    saved_is_moe=bool(meta.get("moe")))
-        abstract = vit_pipeline.convert_layout(abstract, src)
+        abstract = model_scan.convert_layout(abstract, src)
         logging.info(f"checkpoint params will be converted: {src} -> "
                      f"{dst} block layout")
     try:
@@ -854,7 +863,7 @@ def _load_orbax(path: str, state: TrainState, restore_optimizer: bool
         raise ValueError(f"cannot restore orbax checkpoint {path!r}: "
                          f"{e}") from e
     if convert:
-        restored_dict = vit_pipeline.convert_layout(restored_dict, dst)
+        restored_dict = model_scan.convert_layout(restored_dict, dst)
     if not restore_optimizer:
         restored_dict["opt_state"] = template.get("opt_state", {})
     # loss_scale compat — same shim as the msgpack path (see
@@ -958,14 +967,14 @@ def _load_checkpoint_inner(path: str, state: TrainState,
     # The orbax path does the same via meta.json's params_layout
     # (_load_orbax converts the abstract restore target, then the
     # restored arrays).
-    src = vit_pipeline.params_layout(payload["state"].get("params"))
-    dst = vit_pipeline.params_layout(template_sd.get("params"))
+    src = model_scan.params_layout(payload["state"].get("params"))
+    dst = model_scan.params_layout(template_sd.get("params"))
     if src is not None and dst is not None and src != dst:
         _check_layouts_convertible(path, src, dst,
                                    template_sd.get("params"),
                                    payload["state"].get("params"))
-        payload["state"] = vit_pipeline.convert_layout(payload["state"],
-                                                       dst)
+        payload["state"] = model_scan.convert_layout(payload["state"],
+                                                     dst)
         logging.info(f"checkpoint params converted: {src} -> {dst} "
                      "block layout")
     restored = serialization.from_state_dict(template, payload["state"])

@@ -41,7 +41,8 @@ def _build_engine(cfg: Config, model_name: str, dataset: Dataset,
                       pipeline_parallel=cfg.pipeline_parallel,
                       pipeline_microbatches=cfg.pipeline_microbatches,
                       moe_experts=cfg.moe_experts,
-                      precision=policy, remat=cfg.remat)
+                      precision=policy, remat=cfg.remat,
+                      scan_layers=cfg.scan_layers)
     # Working weighted/focal losses (fixes SURVEY defect #4).
     class_weights = (dataset.class_weights()
                      if cfg.loss in ("weighted_cross_entropy", "focal_loss")
@@ -181,7 +182,8 @@ def _make_loader(cfg: Config, split: Split, mesh, shuffle: bool):
     cls = ResidentLoader if resident else ShardedLoader
     return cls(split, mesh, cfg.batch_size, shuffle=shuffle, seed=cfg.seed,
                prefetch=cfg.prefetch,
-               producer_threads=cfg.producer_threads)
+               producer_threads=cfg.producer_threads,
+               device_prefetch=cfg.device_prefetch)
 
 
 def _mfu_factors(engine: Engine) -> tuple:
@@ -301,6 +303,13 @@ def _aot_warmup(cfg: Config, engine: Engine, state, train_loader,
     hit = runtime.compilation_cache_hits() > hits_before
     tel.gauge("compile/warmup_s").set(warmup_s)
     tel.gauge("compile/cache_hit").set(1.0 if hit else 0.0)
+    # Program size is the compile-time driver --scan-layers exists to
+    # shrink: record the summed optimized-HLO instruction count of the
+    # programs just compiled (per-program numbers live in costs.json).
+    instrs = [e.get("hlo_instructions") for e in costs.registry().values()]
+    instrs = [n for n in instrs if n is not None]
+    if instrs:
+        tel.gauge("compile/hlo_instructions").set(float(sum(instrs)))
     # Register the analytic per-sample count beside the XLA estimates so
     # both methodologies live in one costs.json, distinguishable by
     # ``source`` — and only the main process writes the shared file.

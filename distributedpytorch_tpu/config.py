@@ -91,6 +91,14 @@ class Config:
     # on the consumer thread (the pre-overlap behavior, and what direct
     # ShardedLoader constructions default to).
     producer_threads: int = 1
+    # Device-side double-buffered prefetch for the streaming loader: a
+    # dedicated transfer thread issues the sharded device_put for the
+    # next N batches into a bounded device queue while the current step
+    # computes, so H2D overlaps compute.  Composes with
+    # producer_threads (producers then gather host arrays only; the
+    # transfer thread owns all device placement, keeping batch order
+    # byte-identical).  0 = no device-side stage (prior behavior).
+    device_prefetch: int = 0
     # Non-blocking checkpoint saves: only the host snapshot blocks the
     # driver; serialization/file-I/O run on a background writer joined at
     # the next save, preemption, or exit (checkpoint.AsyncSaver).  The
@@ -119,6 +127,14 @@ class Config:
     # save-matmul-outputs jax.checkpoint around the whole apply for flat
     # models), 'full' (checkpoint everything; max memory relief).
     remat: str = "none"
+    # Scan-over-layers: stack homogeneous repeated-block params on a
+    # leading (depth,) axis and run the blocks under lax.scan
+    # (vgg/densenet/inception + the vit family), collapsing O(depth)
+    # HLO into O(1) — smaller programs, faster AOT warmup.  Composes
+    # with --remat blocks (nn.remat inside the scan body).  Checkpoints
+    # self-describe the layout and convert across the flag in both
+    # directions (checkpoint.py / models/scan.py).
+    scan_layers: bool = False
     focal_gamma: float = 2.0               # ref utils.py:144
     # 'resident': split lives in HBM, one XLA dispatch per epoch;
     # 'stream': host batching + prefetch; 'auto' picks by size.
@@ -343,6 +359,20 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                         "(gather + device_put off the driver thread; "
                         "batch order stays byte-identical; default 1; "
                         "0 = produce synchronously on the driver)")
+    p.add_argument("--device-prefetch", type=int, default=0, metavar="N",
+                   dest="devicePrefetch",
+                   help="streamed-mode device-side double-buffer depth: "
+                        "a transfer thread issues the sharded device_put "
+                        "for the next N batches while the current step "
+                        "computes (H2D overlaps compute; batch order "
+                        "stays byte-identical; composes with "
+                        "--producer-threads; default 0 = off)")
+    p.add_argument("--scan-layers", action="store_true", dest="scanLayers",
+                   help="stack homogeneous repeated-block params and run "
+                        "them under lax.scan (vgg/densenet/inception/vit "
+                        "family): O(depth) HLO collapses to O(1) for "
+                        "faster compiles; gradients match the unscanned "
+                        "model; checkpoints convert across the flag")
     p.add_argument("--ckpt-async", action="store_true", dest="ckptAsync",
                    help="non-blocking checkpoint saves: serialization + "
                         "file I/O run on a background writer joined at "
@@ -735,9 +765,11 @@ def config_from_argv(argv=None) -> Config:
         half_precision=not args.no_bf16,
         precision=args.precision,
         remat=args.remat,
+        scan_layers=args.scanLayers,
         data_mode=args.dataMode,
         prefetch=args.prefetch,
         producer_threads=args.producerThreads,
+        device_prefetch=args.devicePrefetch,
         ckpt_async=args.ckptAsync,
         fault_plan=args.faultPlan,
         fault_seed=args.faultSeed,

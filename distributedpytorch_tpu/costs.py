@@ -124,13 +124,20 @@ def record(name: str, compiled: Any, hlo: bool = False) -> dict:
             text = compiled.as_text()
         except Exception:
             text = None  # HLO text is advisory, like cost_analysis
-        if isinstance(text, str) and 0 < len(text) <= _HLO_TEXT_CAP:
-            entry["hlo"] = text
+        if isinstance(text, str) and text:
+            # The instruction count survives even when the text itself
+            # is over the persistence cap: it is the compile-cost
+            # proxy the scan-over-layers work sizes itself by
+            # (O(depth) -> O(1) HLO), and it is a single int.
+            entry["hlo_instructions"] = hlo_instruction_count(text)
+            if len(text) <= _HLO_TEXT_CAP:
+                entry["hlo"] = text
     with _lock:
         _registry[name] = entry
     telemetry.get().event("cost_analysis", program=name,
                           source=entry["source"], flops=entry["flops"],
-                          bytes_accessed=entry["bytes_accessed"])
+                          bytes_accessed=entry["bytes_accessed"],
+                          hlo_instructions=entry.get("hlo_instructions"))
     return entry
 
 
@@ -335,6 +342,18 @@ def _conv_flops(line: str, result_elems: float, operands: str) -> float:
     for d in kdims:
         prod *= d
     return 2.0 * result_elems * prod / max(kdims, default=1)
+
+
+def hlo_instruction_count(hlo_text: str) -> int:
+    """Total instruction count across every computation of an optimized
+    HLO module — the program-size metric behind the scan-over-layers
+    win (an unrolled depth-L model carries ~L copies of each block
+    instruction; under ``lax.scan`` one copy, so the count collapses
+    from O(depth) to O(1)).  Counts every ``%name = shape opcode(...)``
+    line, parameters included; relative comparisons (scan vs noscan of
+    the same model) are what the number is for."""
+    return sum(1 for line in hlo_text.splitlines()
+               if _INSTR_RE.match(line))
 
 
 def hlo_op_costs(hlo_text: str) -> Dict[str, dict]:

@@ -53,8 +53,9 @@ class ResidentLoader:
 
     def __init__(self, split: Split, mesh: Mesh, batch_per_replica: int,
                  shuffle: bool, seed: int, prefetch: int = 0,
-                 producer_threads: int = 0):
-        del prefetch, producer_threads  # no host loop to prefetch for
+                 producer_threads: int = 0, device_prefetch: int = 0):
+        # no host loop to prefetch for
+        del prefetch, producer_threads, device_prefetch
         self.mesh = mesh
         self.batch_per_replica = batch_per_replica
         self.world = mesh.devices.size
@@ -129,11 +130,25 @@ class ShardedLoader:
     (values AND order) to the synchronous path.  0 = the synchronous
     reference behavior (and what direct library constructions default to;
     the CLI default is 1 — see Config.producer_threads).
+
+    ``device_prefetch > 0`` adds a device-side double-buffer stage on top:
+    a dedicated transfer thread issues the sharded ``jax.device_put`` for
+    batches t+1..t+N into a bounded device queue while the consumer
+    computes step t, so the H2D copy overlaps device work instead of
+    serializing inside the step loop.  It composes with
+    ``producer_threads`` (producers then gather HOST arrays only; the
+    single transfer thread owns ALL device placement, in step order, so
+    the stream stays byte-identical) and with elastic
+    ``release()``/``reshard()`` (in-flight transfers are stopped, drained
+    and joined).  Consumer blocking on the device queue is charged to the
+    ``data/device_wait_s`` telemetry counter; the goodput ledger's
+    ``data_wait`` still sees it through the step loop's inter-step window
+    (cli._run_train_pass) — see ``epoch()``.
     """
 
     def __init__(self, split: Split, mesh: Mesh, batch_per_replica: int,
                  shuffle: bool, seed: int, prefetch: int = 2,
-                 producer_threads: int = 0):
+                 producer_threads: int = 0, device_prefetch: int = 0):
         self.split = split
         self.mesh = mesh
         self.batch_per_replica = batch_per_replica
@@ -146,6 +161,7 @@ class ShardedLoader:
         # these fine, so 0 is only for that environment.
         self.prefetch = max(0, prefetch)
         self.producer_threads = max(0, producer_threads)
+        self.device_prefetch = max(0, device_prefetch)
         self.world = mesh.devices.size
         self.sharding = NamedSharding(mesh, P(DATA_AXIS))
 
@@ -173,6 +189,12 @@ class ShardedLoader:
         # them post-epoch), bounded to the newest few.
         self._queues: "collections.OrderedDict[int, object]" = \
             collections.OrderedDict()
+        # Live background machinery (threaded/device-prefetch epochs):
+        # each entry holds the stop event, threads and bounded queues of
+        # one in-flight epoch generator, so ``release()`` can stop,
+        # drain, and join them even while transfers are in flight.
+        self._active_runs: list = []
+        self._runs_lock = threading.Lock()
 
     _QUEUE_HISTORY = 8  # retained per-epoch entries (newest kept)
 
@@ -204,12 +226,53 @@ class ShardedLoader:
             return sum(x.qsize() for x in q)
         return len(q)  # synchronous path: one deque
 
+    def _register_run(self, run: dict) -> None:
+        with self._runs_lock:
+            self._active_runs.append(run)
+
+    def _unregister_run(self, run: dict) -> None:
+        with self._runs_lock:
+            try:
+                self._active_runs.remove(run)
+            except ValueError:
+                pass
+
+    @staticmethod
+    def _drain(q) -> None:
+        while True:
+            try:
+                q.get_nowait()
+            except queue_mod.Empty:
+                break
+
+    @classmethod
+    def _shutdown_run(cls, run: dict) -> None:
+        """Stop one epoch's background machinery: signal, unblock any
+        producer parked on a full queue, join, then drop whatever device
+        batches the join race let through."""
+        run["stop"].set()
+        for q in run["queues"]:
+            cls._drain(q)
+        for th in run["threads"]:
+            th.join()
+        for q in run["queues"]:
+            cls._drain(q)
+
     def release(self) -> None:
         """Drop every device-backed reference — mesh, sharding, prefetch
         queues (their entries are device batches) — keeping only the
         plain-host fields ``reshard`` needs.  Elastic pre-teardown
         (cli.run_train): the old world's backend cannot be destroyed,
-        and its gloo sockets closed, while loader state pins it."""
+        and its gloo sockets closed, while loader state pins it.
+        Background epochs (threaded producers, device-prefetch transfer
+        threads) are stopped, drained and JOINED first, so no in-flight
+        ``device_put`` outlives the mesh it targets."""
+        with self._runs_lock:
+            runs = list(self._active_runs)
+        for run in runs:
+            self._shutdown_run(run)
+        with self._runs_lock:
+            self._active_runs.clear()
         self.mesh = None
         self.sharding = None
         self._queues.clear()
@@ -229,7 +292,8 @@ class ShardedLoader:
         return ShardedLoader(self.split, mesh, self.batch_per_replica,
                              shuffle=self.shuffle, seed=self.seed,
                              prefetch=self.prefetch,
-                             producer_threads=self.producer_threads)
+                             producer_threads=self.producer_threads,
+                             device_prefetch=self.device_prefetch)
 
     def __len__(self) -> int:
         return self.batches_per_epoch
@@ -306,6 +370,9 @@ class ShardedLoader:
         both would double-count and break the sums-to-wall invariant.
         """
         tel = telemetry.get()
+        if self.device_prefetch > 0:
+            yield from self._device_prefetch_epoch(epoch, tel)
+            return
         if self.producer_threads > 0:
             yield from self._threaded_epoch(epoch, tel)
             return
@@ -382,6 +449,137 @@ class ShardedLoader:
             wait.add(dt)
             wait_hist.observe(dt)
 
+    def _device_prefetch_epoch(self, epoch: int, tel):
+        """Device-side double-buffered iterator: ONE transfer thread
+        issues the sharded ``device_put`` for upcoming batches into a
+        bounded device queue (maxsize = ``device_prefetch``) while the
+        consumer computes the current step — H2D overlaps compute even
+        when the consumer thread never yields the GIL between steps.
+
+        Composition with ``producer_threads > 0``: producer threads do
+        the numpy gather only (HOST arrays into their bounded per-thread
+        queues, thread t owning steps t, t+N, ...); the transfer thread
+        round-robins them in step order and owns every device placement,
+        so the stream stays byte-identical (values AND order) to the
+        synchronous path — same contract as ``_threaded_epoch``.
+
+        Shutdown: the generator's ``finally`` — or an elastic
+        ``release()`` racing it — sets the stop event, drains every
+        queue (dropping in-flight device batches), and joins all
+        threads; no transfer outlives its epoch or its mesh.
+
+        Telemetry (enabled path): consumer blocking on the device queue
+        is charged to ``data/device_wait_s`` (counter + histogram) — its
+        own counter, NOT ``data/wait_s``, so reports can split "host
+        production stalled" from "H2D did not overlap".  The goodput
+        ledger's ``data_wait`` category still captures this blocking via
+        the step loop's inter-step window (cli._run_train_pass); this
+        iterator deliberately charges goodput nothing (see ``epoch()``).
+        """
+        nb = self.batches_per_epoch
+        stop = threading.Event()
+        dev_q = queue_mod.Queue(maxsize=self.device_prefetch)
+        per_rank = [s.epoch_indices(epoch) for s in self.samplers]
+        host_batch = self._host_batch_fn()
+        host_queues: list = []
+        threads: list = []
+
+        def _put(q, item) -> None:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return
+                except queue_mod.Full:
+                    continue
+
+        if self.producer_threads > 0:
+            nthreads = self.producer_threads
+            host_queues = [queue_mod.Queue(maxsize=max(1, self.prefetch))
+                           for _ in range(nthreads)]
+
+            def produce(t: int, q) -> None:
+                try:
+                    for step in range(t, nb, nthreads):
+                        if stop.is_set():
+                            return
+                        _put(q, host_batch(per_rank, step))
+                except BaseException as e:  # propagate via the stream
+                    _put(q, _ProducerFailure(e))
+
+            threads = [
+                threading.Thread(
+                    target=produce, args=(t, host_queues[t]),
+                    name=f"dpt-gather-{epoch}-{t}", daemon=True)
+                for t in range(nthreads)
+            ]
+
+            def host_stream():
+                for step in range(nb):
+                    q = host_queues[step % nthreads]
+                    while not stop.is_set():
+                        try:
+                            yield q.get(timeout=0.05)
+                            break
+                        except queue_mod.Empty:
+                            continue
+                    else:
+                        return
+        else:
+            def host_stream():
+                for step in range(nb):
+                    if stop.is_set():
+                        return
+                    yield host_batch(per_rank, step)
+
+        def transfer() -> None:
+            try:
+                for item in host_stream():
+                    if isinstance(item, _ProducerFailure):
+                        _put(dev_q, item)
+                        return
+                    _put(dev_q, self._to_device(item))
+            except BaseException as e:
+                # transfer thread: ANY failure (device_put OOM included)
+                # must reach the consumer as a _ProducerFailure or the
+                # step loop blocks on dev_q forever
+                _put(dev_q, _ProducerFailure(e))
+
+        threads.append(threading.Thread(
+            target=transfer, name=f"dpt-h2d-{epoch}", daemon=True))
+        all_queues = [dev_q] + host_queues
+        self._register_queue(epoch, all_queues)
+        run = {"stop": stop, "threads": threads, "queues": all_queues}
+        self._register_run(run)
+        for th in threads:
+            th.start()
+        enabled = tel.enabled
+        if enabled:
+            dwait = tel.counter("data/device_wait_s")
+            dwait_hist = tel.histogram("data/device_wait_s")
+            batches = tel.counter("data/batches")
+            starved = tel.counter("data/starved_steps")
+            depth_sum = tel.counter("data/queue_depth_sum")
+        try:
+            for _step in range(nb):
+                if enabled:
+                    depth_sum.add(sum(q.qsize() for q in all_queues))
+                    if dev_q.empty():
+                        starved.add(1)
+                    t0 = time.perf_counter()
+                    item = dev_q.get()
+                    dt = time.perf_counter() - t0
+                    dwait.add(dt)
+                    dwait_hist.observe(dt)
+                    batches.add(1)
+                else:
+                    item = dev_q.get()
+                if isinstance(item, _ProducerFailure):
+                    raise item.exc
+                yield item
+        finally:
+            self._shutdown_run(run)
+            self._unregister_run(run)
+
     def _threaded_epoch(self, epoch: int, tel):
         """Background-producer iterator: host gather + device_put dispatch
         run on ``producer_threads`` threads feeding bounded queues.
@@ -435,6 +633,8 @@ class ShardedLoader:
                              name=f"dpt-producer-{epoch}-{t}", daemon=True)
             for t in range(nthreads)
         ]
+        run = {"stop": stop, "threads": threads, "queues": queues}
+        self._register_run(run)
         for th in threads:
             th.start()
         enabled = tel.enabled
@@ -463,12 +663,5 @@ class ShardedLoader:
                     raise item.exc
                 yield item
         finally:
-            stop.set()
-            for q in queues:  # unblock producers stuck on a full queue
-                while True:
-                    try:
-                        q.get_nowait()
-                    except queue_mod.Empty:
-                        break
-            for th in threads:
-                th.join()
+            self._shutdown_run(run)
+            self._unregister_run(run)

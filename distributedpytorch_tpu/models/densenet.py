@@ -45,6 +45,12 @@ class DenseNet(nn.Module):
     # the concat of all earlier features), so this is the model the knob
     # was built for.
     remat: bool = False
+    # --scan-layers: each dense block's DenseLayer chain runs under one
+    # lax.scan over a zero-padded channel buffer (models/scan.py
+    # _DenseStep) — 58 inlined layers collapse to 4 scan bodies, the
+    # biggest compile-time win in the zoo.  Checkpoints convert across
+    # the flag ('dense_scan' <-> 'dense_layers').
+    scan_layers: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -64,13 +70,22 @@ class DenseNet(nn.Module):
         x = nn.relu(norm()(x))
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
         for i, n_layers in enumerate(self.block_config):
-            for _ in range(n_layers):
-                # Explicit name matching the historical auto-name, so the
-                # param tree (and every checkpoint) is identical with and
-                # without remat.
-                x = layer_cls(self.growth, self.bn_size, self.dtype,
-                              name=f"DenseLayer_{layer_idx}")(x, train)
-                layer_idx += 1
+            if self.scan_layers:
+                from . import scan
+
+                x = scan.scan_dense_block(
+                    n_layers, x.shape[-1], self.growth, self.bn_size,
+                    self.dtype, train, name=f"DenseBlockScan_{i}",
+                    remat=self.remat)(x)
+                layer_idx += n_layers
+            else:
+                for _ in range(n_layers):
+                    # Explicit name matching the historical auto-name, so
+                    # the param tree (and every checkpoint) is identical
+                    # with and without remat.
+                    x = layer_cls(self.growth, self.bn_size, self.dtype,
+                                  name=f"DenseLayer_{layer_idx}")(x, train)
+                    layer_idx += 1
             if i != len(self.block_config) - 1:  # transition
                 x = nn.relu(norm()(x))
                 x = nn.Conv(x.shape[-1] // 2, (1, 1), use_bias=False,
@@ -82,6 +97,7 @@ class DenseNet(nn.Module):
         return x.astype(jnp.float32)
 
 
-def densenet121(num_classes: int, dtype=jnp.bfloat16,
-                remat: bool = False) -> DenseNet:
-    return DenseNet(num_classes=num_classes, dtype=dtype, remat=remat)
+def densenet121(num_classes: int, dtype=jnp.bfloat16, remat: bool = False,
+                scan_layers: bool = False) -> DenseNet:
+    return DenseNet(num_classes=num_classes, dtype=dtype, remat=remat,
+                    scan_layers=scan_layers)

@@ -170,6 +170,12 @@ class InceptionV3(nn.Module):
     # The 299px stem stays un-checkpointed (it is a handful of convs; the
     # activation bulk sits in the 35x35/17x17 Mixed blocks).
     remat: bool = False
+    # --scan-layers: the one homogeneous Mixed run (InceptionC_1 and
+    # InceptionC_2 — both 768-in/768-out with c7=160) runs under
+    # lax.scan as InceptionCScan_0 (models/scan.py); every other block
+    # keeps its exact historical name.  Checkpoints convert across the
+    # flag ('inception_scan' <-> 'inception_blocks').
+    scan_layers: bool = False
 
     def _block(self, cls):
         """Block class, nn.remat-wrapped under --remat blocks.  Call sites
@@ -203,8 +209,17 @@ class InceptionV3(nn.Module):
         x = inc_a(64, self.dtype, name="InceptionA_1")(x, train)
         x = inc_a(64, self.dtype, name="InceptionA_2")(x, train)
         x = inc_b(self.dtype, name="InceptionB_0")(x, train)
-        for i, c7 in enumerate((128, 160, 160, 192)):
-            x = inc_c(c7, self.dtype, name=f"InceptionC_{i}")(x, train)
+        if self.scan_layers:
+            from . import scan
+
+            x = inc_c(128, self.dtype, name="InceptionC_0")(x, train)
+            x = scan.scan_run(
+                inc_c, 2, dict(channels_7x7=160, dtype=self.dtype),
+                train, name="InceptionCScan_0")(x)
+            x = inc_c(192, self.dtype, name="InceptionC_3")(x, train)
+        else:
+            for i, c7 in enumerate((128, 160, 160, 192)):
+                x = inc_c(c7, self.dtype, name=f"InceptionC_{i}")(x, train)
         aux = AuxHead(self.num_classes, self.dtype)(x, train) if train \
             else None
         x = inc_d(self.dtype, name="InceptionD_0")(x, train)

@@ -22,18 +22,22 @@ from .vgg import VGG11BN
 from .vit import ViT
 
 MODEL_REGISTRY: Dict[str, Callable[..., nn.Module]] = {
-    "cnn": lambda n, d, r: SmallCNN(num_classes=n, dtype=d),
-    "mlp": lambda n, d, r: MLP(num_classes=n, dtype=d),
-    "resnet": lambda n, d, r: resnet18(n, d),        # ref utils.py:42-49
-    "alexnet": lambda n, d, r: AlexNet(num_classes=n, dtype=d),  # :51-58
-    "vgg": lambda n, d, r: VGG11BN(num_classes=n, dtype=d),      # :60-67
-    "squeezenet": lambda n, d, r: SqueezeNet(num_classes=n, dtype=d),
-    "densenet": lambda n, d, r: densenet121(n, d, remat=r),  # :78-85
-    "inception": lambda n, d, r: InceptionV3(num_classes=n, dtype=d,
-                                             remat=r),       # :87-99
+    "cnn": lambda n, d, r, s: SmallCNN(num_classes=n, dtype=d),
+    "mlp": lambda n, d, r, s: MLP(num_classes=n, dtype=d),
+    "resnet": lambda n, d, r, s: resnet18(n, d),     # ref utils.py:42-49
+    "alexnet": lambda n, d, r, s: AlexNet(num_classes=n, dtype=d),  # :51-58
+    "vgg": lambda n, d, r, s: VGG11BN(num_classes=n, dtype=d,
+                                      scan_layers=s),        # :60-67
+    "squeezenet": lambda n, d, r, s: SqueezeNet(num_classes=n, dtype=d),
+    "densenet": lambda n, d, r, s: densenet121(n, d, remat=r,
+                                               scan_layers=s),  # :78-85
+    "inception": lambda n, d, r, s: InceptionV3(num_classes=n, dtype=d,
+                                                remat=r,
+                                                scan_layers=s),  # :87-99
     # Framework addition beyond the reference zoo (which is CNN-only):
     # the attention model family, see models/vit.py + ops/attention.py.
-    "vit": lambda n, d, r: ViT(num_classes=n, dtype=d, remat=r),
+    "vit": lambda n, d, r, s: ViT(num_classes=n, dtype=d, remat=r,
+                                  scan_layers=s),
 }
 
 # Models that implement --remat blocks THEMSELVES via nn.remat at their
@@ -49,6 +53,10 @@ _INPUT_SIZES = {
     "cnn": 28, "mlp": 28, "resnet": 224, "alexnet": 224, "vgg": 224,
     "squeezenet": 224, "densenet": 224, "inception": 299, "vit": 28,
 }
+
+# Models with homogeneous repeated blocks that --scan-layers stacks
+# under lax.scan (models/scan.py): O(depth) HLO collapses to O(1).
+SCAN_LAYER_MODELS = frozenset({"vit", "vgg", "densenet", "inception"})
 
 # Models whose train-mode forward also returns auxiliary logits
 # (ref classif.py:49-53 special-cases 'inception').
@@ -74,7 +82,8 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
               pipeline_parallel: bool = False,
               pipeline_microbatches: int = 0,
               moe_experts: int = 0, pallas_dw: bool = False,
-              precision=None, remat: str = "none") -> nn.Module:
+              precision=None, remat: str = "none",
+              scan_layers: bool = False) -> nn.Module:
     """``attention``: 'full' (default, XLA-fused softmax attention),
     'ring' (sequence-parallel over ``mesh``'s 'model' axis via
     lax.ppermute — ops/attention.py), 'flash' (the Pallas kernel,
@@ -101,6 +110,22 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
     # Model-internal block remat only for --remat blocks; --remat full is
     # handled by the engine (whole-apply jax.checkpoint), not the model.
     remat_blocks = remat == "blocks"
+    if scan_layers:
+        if name not in SCAN_LAYER_MODELS:
+            raise ValueError(
+                f"--scan-layers applies to the repeated-block models "
+                f"only ({sorted(SCAN_LAYER_MODELS)}); {name!r} has no "
+                "homogeneous block run to stack")
+        if pipeline_parallel:
+            raise ValueError(
+                "--scan-layers is exclusive with --pipeline-parallel "
+                "(the pipelined vit already stacks its blocks and "
+                "hand-rolls the schedule)")
+        if moe_experts:
+            raise ValueError(
+                "--scan-layers is exclusive with --moe-experts (expert "
+                "dispatch does not stack under lax.scan, and MoE "
+                "checkpoints have no scan layout conversion)")
     if pipeline_parallel and remat != "none":
         raise ValueError(
             "--remat composes with the plain vit, not --pipeline-parallel "
@@ -208,7 +233,7 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
             return ViT(num_classes=num_classes, dtype=dtype,
                        attention_fn=attn_fn,
                        tp_constrain=make_tp_constrain(mesh),
-                       remat=remat_blocks)
+                       remat=remat_blocks, scan_layers=scan_layers)
         if moe_experts:
             # Expert parallelism when a model axis exists (>= 2 devices
             # on 'model'): the expert batches' leading E axis is pinned
@@ -235,8 +260,10 @@ def get_model(name: str, num_classes: int, half_precision: bool = True,
                        attention_fn=attn_fn, moe_experts=moe_experts,
                        moe_constrain=moe_constrain, remat=remat_blocks)
         return ViT(num_classes=num_classes, dtype=dtype,
-                   attention_fn=attn_fn, remat=remat_blocks)
-    return MODEL_REGISTRY[name](num_classes, dtype, remat_blocks)
+                   attention_fn=attn_fn, remat=remat_blocks,
+                   scan_layers=scan_layers)
+    return MODEL_REGISTRY[name](num_classes, dtype, remat_blocks,
+                                scan_layers)
 
 
 def get_model_input_size(name: str) -> int:

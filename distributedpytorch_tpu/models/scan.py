@@ -1,0 +1,505 @@
+"""Scan-over-layers: homogeneous zoo blocks stacked and run under
+``lax.scan`` (--scan-layers), collapsing O(depth) HLO into O(1).
+
+Why: XLA unrolls a Python-loop model into one instruction stream per
+block — densenet121's 58 DenseLayers each contribute their convs, norms
+and concats, so program size (and compile time, and the AOT-warmup cost
+the goodput ledger charges to ``compile``) grows linearly with depth.
+``nn.scan`` emits ONE while-loop body holding a single block's program
+with the per-block parameters stacked on a leading (depth,) axis —
+compile cost becomes O(1) in depth, and the whole-program optimizer
+sees a small graph it can actually fuse.
+
+What stacks, per model (the rest of each model is untouched, and every
+non-scanned parameter keeps its exact historical name):
+
+  * vit — all ``depth`` TransformerBlocks under one scan ("blocks");
+  * densenet — each dense block's DenseLayer chain (6/12/24/16 layers)
+    under one scan per block ("DenseBlockScan_{b}") via a zero-padded
+    channel buffer (see _DenseStep: the growing concat becomes a
+    fixed-width carry + dynamic_update_slice);
+  * inception — the homogeneous InceptionC_1/InceptionC_2 pair
+    (same 768-in/768-out, c7=160) as "InceptionCScan_0";
+  * vgg — the trailing 512->512 conv+BN pair as "ConvScan_0".
+
+Composition with --remat blocks: callers pass an ``nn.remat``-wrapped
+block class (vit/inception) or set ``remat=True`` here (densenet) — the
+scan body is then rematerialized per step, the scan-over-remat memory
+shape (O(sqrt)-style: live activations are one block deep).
+
+Checkpoint layouts: scanned trees are a DIFFERENT on-disk shape, so this
+module is also the layout registry checkpoint.py consults —
+``params_layout`` names the layout a params(-shaped) tree is in, and
+``convert_layout`` converts any state dict (params, batch_stats, AND the
+optimizer moments that mirror params) across layouts in both directions,
+working at shape level on jax.ShapeDtypeStruct trees too (orbax abstract
+restore targets).  The vit-family 'stacked'/'blocks' layouts remain in
+models/vit_pipeline.py; this module subsumes them for dispatch.
+
+Numerics: scan == loop exactly, given converted parameters — pinned by
+tests/test_scan_layers.py (forward AND gradients) and gated in CI
+(scripts/scan_gate.py).  The densenet padded-buffer trick masks the
+padded channels after norm1 (see _DenseStep) so no gradient ever reaches
+a padded parameter entry; padding is therefore inert and zero-filled.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import vit_pipeline
+
+# ---------------------------------------------------------------------------
+# scan runners
+
+
+class _BlockStep(nn.Module):
+    """nn.scan body adapter: applies one homogeneous zoo block to the
+    carried activation.  ``block_cls`` may already be nn.remat-wrapped
+    (vit/inception --remat blocks); the inner instance is always named
+    "block" so the stacked subtree is {scan_name}/block/{...}."""
+
+    block_cls: Any
+    block_kwargs: Tuple[Tuple[str, Any], ...]
+    train: bool
+
+    @nn.compact
+    def __call__(self, x, _i):
+        y = self.block_cls(**dict(self.block_kwargs),
+                           name="block")(x, self.train)
+        return y, None
+
+
+def scan_run(block_cls, length: int, block_kwargs: dict, train: bool,
+             name: str):
+    """``length`` applications of one block class under lax.scan; returns
+    a callable x -> x.  Params: {name}/block/{leaf} with a leading
+    (length,) axis (variable_axes=0), per-step init rngs (split_rngs)."""
+    scanned = nn.scan(
+        _BlockStep,
+        variable_axes={"params": 0, "batch_stats": 0},
+        split_rngs={"params": True},
+        in_axes=0, length=length)
+    mod = scanned(block_cls=block_cls,
+                  block_kwargs=tuple(block_kwargs.items()),
+                  train=train, name=name)
+
+    def run(x):
+        y, _ = mod(x, jnp.arange(length))
+        return y
+
+    return run
+
+
+class _DenseStep(nn.Module):
+    """One DenseLayer as a fixed-shape scan step over a padded channel
+    buffer.
+
+    The loop model concatenates each layer's ``growth`` new channels onto
+    a growing feature map — shapes change per layer, which lax.scan
+    cannot carry.  Instead the carry is a zero-padded buffer of the
+    block's FINAL width (c_in + length*growth); step i reads the buffer,
+    masks everything past its valid width c_i = c_in + i*growth after
+    norm1+relu, and writes its ``growth`` outputs at offset c_i with
+    ``dynamic_update_slice`` (traced offset — one program for all steps).
+
+    The mask is load-bearing for exactness, not cosmetics: BatchNorm over
+    the padded channels emits relu(bias) > 0 garbage there, and without
+    the mask those values would feed conv1 through its (trainable!)
+    padded kernel rows — forward would diverge from the loop model and
+    gradients would flow into padding.  Masked, the padded inputs are
+    identically zero, so the padded kernel rows and the padded norm
+    scale/bias entries receive exactly zero gradient and stay at their
+    (zero) converted values — the scanned model IS the loop model.
+    """
+
+    growth: int
+    bn_size: int
+    in_features: int
+    dtype: Any
+    train: bool
+
+    @nn.compact
+    def __call__(self, buf, i):
+        norm = functools.partial(nn.BatchNorm,
+                                 use_running_average=not self.train,
+                                 momentum=0.9, dtype=self.dtype)
+        c_i = self.in_features + i * self.growth
+        valid = jax.lax.broadcasted_iota(
+            jnp.int32, (buf.shape[-1],), 0) < c_i
+        y = nn.relu(norm()(buf))
+        y = jnp.where(valid, y, jnp.zeros_like(y))
+        y = nn.Conv(self.bn_size * self.growth, (1, 1), use_bias=False,
+                    dtype=self.dtype)(y)
+        y = nn.relu(norm()(y))
+        y = nn.Conv(self.growth, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(y)
+        buf = jax.lax.dynamic_update_slice(
+            buf, y.astype(buf.dtype), (0, 0, 0, c_i))
+        return buf, None
+
+
+def scan_dense_block(length: int, in_features: int, growth: int,
+                     bn_size: int, dtype, train: bool, name: str,
+                     remat: bool = False):
+    """One densenet dense block (``length`` DenseLayers) under lax.scan;
+    returns a callable x -> x with the full concatenated width."""
+    step_cls = _DenseStep
+    if remat:
+        step_cls = nn.remat(
+            _DenseStep, prevent_cse=False,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    scanned = nn.scan(
+        step_cls,
+        variable_axes={"params": 0, "batch_stats": 0},
+        split_rngs={"params": True},
+        in_axes=0, length=length)
+    mod = scanned(growth=growth, bn_size=bn_size, in_features=in_features,
+                  dtype=dtype, train=train, name=name)
+
+    def run(x):
+        c_end = in_features + length * growth
+        buf = jnp.pad(x, ((0, 0), (0, 0), (0, 0),
+                          (0, c_end - x.shape[-1])))
+        buf, _ = mod(buf, jnp.arange(length))
+        return buf
+
+    return run
+
+
+class _VGGStep(nn.Module):
+    """One vgg conv+BN+relu unit as a scan step (homogeneous 512->512
+    runs only; bias kept on the conv for torchvision state_dict parity,
+    same as the unscanned path)."""
+
+    filters: int
+    dtype: Any
+    train: bool
+
+    @nn.compact
+    def __call__(self, x, _i):
+        x = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=True,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not self.train, momentum=0.9,
+                         dtype=self.dtype)(x)
+        return nn.relu(x), None
+
+
+def scan_vgg_run(length: int, filters: int, dtype, train: bool,
+                 name: str):
+    scanned = nn.scan(
+        _VGGStep,
+        variable_axes={"params": 0, "batch_stats": 0},
+        split_rngs={"params": True},
+        in_axes=0, length=length)
+    mod = scanned(filters=filters, dtype=dtype, train=train, name=name)
+
+    def run(x):
+        y, _ = mod(x, jnp.arange(length))
+        return y
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# layout registry (checkpoint.py's single dispatch point)
+#
+# Layout names:
+#   'stacked' / 'blocks'           vit family (models/vit_pipeline.py)
+#   'scan'                         vit with --scan-layers
+#   'dense_layers' / 'dense_scan'  densenet plain / scanned
+#   'vgg_layers' / 'vgg_scan'      vgg plain / scanned
+#   'inception_blocks' / 'inception_scan'
+#
+# Same-family pairs are convertible both ways ('scan' also reaches
+# 'stacked' transitively via 'blocks'); cross-family targets raise.
+
+_VIT_FAMILY = ("stacked", "blocks", "scan")
+_PAIRS = {
+    "dense_scan": "dense_layers", "dense_layers": "dense_scan",
+    "vgg_scan": "vgg_layers", "vgg_layers": "vgg_scan",
+    "inception_scan": "inception_blocks",
+    "inception_blocks": "inception_scan",
+}
+KNOWN_LAYOUTS = frozenset(_VIT_FAMILY) | frozenset(_PAIRS)
+
+# densenet121 block geometry (models/densenet.py defaults — the only
+# densenet the zoo instantiates): per scanned block, the flat DenseLayer
+# index offset, layer count, entry width and padded carry width.
+_DN_GROWTH, _DN_BN_SIZE = 32, 4
+
+
+def _densenet_specs(block_config=(6, 12, 24, 16), growth=_DN_GROWTH,
+                    init_features=64):
+    specs, c, offset = [], init_features, 0
+    for b, length in enumerate(block_config):
+        specs.append({"name": f"DenseBlockScan_{b}", "offset": offset,
+                      "length": length, "c_in": c,
+                      "c_end": c + length * growth})
+        offset += length
+        c += length * growth
+        if b != len(block_config) - 1:
+            c //= 2  # transition compression
+    return specs
+
+
+def params_layout(sd) -> Optional[str]:
+    """Name the layout of a params(-shaped) mapping — a live tree, a
+    state-dict subtree, optimizer moments, or batch_stats (all mirror
+    the module structure).  None: not a convertible layout."""
+    vp = vit_pipeline.params_layout(sd)
+    if vp is not None:
+        return vp
+    if not isinstance(sd, dict):
+        return None
+    blk = sd.get("blocks")
+    if isinstance(blk, dict) and "block" in blk:
+        return "scan"
+    if "DenseBlockScan_0" in sd:
+        return "dense_scan"
+    if "DenseLayer_0" in sd:
+        return "dense_layers"
+    if "InceptionCScan_0" in sd:
+        return "inception_scan"
+    if "InceptionC_1" in sd:
+        return "inception_blocks"
+    if "ConvScan_0" in sd:
+        return "vgg_scan"
+    if "BatchNorm_7" in sd and "BatchNorm_8" not in sd \
+            and ("Conv_7" in sd or "Conv_0" not in sd):
+        # vgg11_bn is the only zoo model with exactly 8 top-level BN
+        # units; the second arm admits batch_stats trees (no Conv keys).
+        return "vgg_layers"
+    return None
+
+
+# -- shape-level leaf ops (arrays AND ShapeDtypeStruct restore targets) --
+
+_leaf_slice = vit_pipeline._leaf_slice
+_leaf_stack = vit_pipeline._leaf_stack
+
+
+def _leaf_crop(v, axis: int, size: int):
+    if isinstance(v, jax.ShapeDtypeStruct):
+        shape = list(v.shape)
+        shape[axis] = size
+        return jax.ShapeDtypeStruct(tuple(shape), v.dtype,
+                                    sharding=v.sharding)
+    a = np.asarray(v)
+    idx = [slice(None)] * a.ndim
+    idx[axis] = slice(0, size)
+    return a[tuple(idx)]
+
+
+def _leaf_pad(v, axis: int, size: int):
+    """Zero-pad ``axis`` up to ``size``.  Zeros are correct for EVERY
+    leaf kind (params, running stats, optimizer moments): the padded
+    entries are masked out of the forward (see _DenseStep), receive zero
+    gradient, and zero moments make the optimizer leave them alone."""
+    if isinstance(v, jax.ShapeDtypeStruct):
+        shape = list(v.shape)
+        shape[axis] = size
+        return jax.ShapeDtypeStruct(tuple(shape), v.dtype,
+                                    sharding=v.sharding)
+    a = np.asarray(v)
+    pad = [(0, 0)] * a.ndim
+    pad[axis] = (0, size - a.shape[axis])
+    return np.pad(a, pad)
+
+
+def _tree_slice(tree, i: int):
+    return jax.tree_util.tree_map(lambda v: _leaf_slice(v, i), tree)
+
+
+def _tree_stack(trees):
+    return jax.tree_util.tree_map(lambda *vs: _leaf_stack(list(vs)),
+                                  *trees)
+
+
+def _tree_width(tree, fn):
+    """Apply a per-leaf width op to the channel axis of a dense-layer
+    subtree: BatchNorm_0 leaves (params scale/bias, stats mean/var) on
+    axis 0; Conv_0's kernel(-shaped) leaves on their input-channel axis
+    (ndim-2).  Other submodules (BatchNorm_1, Conv_1) have fixed widths
+    and pass through."""
+    out = {}
+    for key, sub in tree.items():
+        if key == "BatchNorm_0":
+            out[key] = {k: fn(v, 0) for k, v in sub.items()}
+        elif key == "Conv_0":
+            out[key] = {k: fn(v, max(0, _leaf_ndim(v) - 2))
+                        for k, v in sub.items()}
+        else:
+            out[key] = sub
+    return out
+
+
+def _leaf_ndim(v) -> int:
+    if isinstance(v, jax.ShapeDtypeStruct):
+        return len(v.shape)
+    return np.asarray(v).ndim
+
+
+# -- vit: scan <-> blocks --
+
+def _scan_depth(stacked) -> int:
+    leaves = jax.tree_util.tree_leaves(
+        stacked, is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+    return int(leaves[0].shape[0])
+
+
+def _vit_scan_to_blocks(sd: dict) -> dict:
+    stacked = sd["blocks"]["block"]
+    out = {k: v for k, v in sd.items() if k != "blocks"}
+    for i in range(_scan_depth(stacked)):
+        out[f"block{i}"] = _tree_slice(stacked, i)
+    return out
+
+
+def _vit_blocks_to_scan(sd: dict) -> dict:
+    blocks = sorted((k for k in sd if k.startswith("block")
+                     and k[5:].isdigit()), key=lambda s: int(s[5:]))
+    out = {k: v for k, v in sd.items() if k not in blocks}
+    out["blocks"] = {"block": _tree_stack([sd[b] for b in blocks])}
+    return out
+
+
+# -- densenet: dense_scan <-> dense_layers --
+
+def _dense_scan_to_layers(sd: dict) -> dict:
+    specs = [s for s in _densenet_specs() if s["name"] in sd]
+    out = {k: v for k, v in sd.items()
+           if k not in {s["name"] for s in specs}}
+    for s in specs:
+        for i in range(s["length"]):
+            c_i = s["c_in"] + i * _DN_GROWTH
+            layer = _tree_width(_tree_slice(sd[s["name"]], i),
+                                lambda v, ax: _leaf_crop(v, ax, c_i))
+            out[f"DenseLayer_{s['offset'] + i}"] = layer
+    return out
+
+
+def _dense_layers_to_scan(sd: dict) -> dict:
+    specs = [s for s in _densenet_specs()
+             if f"DenseLayer_{s['offset']}" in sd]
+    names = {f"DenseLayer_{s['offset'] + i}"
+             for s in specs for i in range(s["length"])}
+    out = {k: v for k, v in sd.items() if k not in names}
+    for s in specs:
+        padded = [
+            _tree_width(sd[f"DenseLayer_{s['offset'] + i}"],
+                        lambda v, ax: _leaf_pad(v, ax, s["c_end"]))
+            for i in range(s["length"])
+        ]
+        out[s["name"]] = _tree_stack(padded)
+    return out
+
+
+# -- vgg: vgg_scan <-> vgg_layers (the trailing Conv_6/Conv_7 run) --
+
+_VGG_RUN = ("6", "7")  # plain indices covered by ConvScan_0
+
+
+def _vgg_scan_to_layers(sd: dict) -> dict:
+    out = {k: v for k, v in sd.items() if k != "ConvScan_0"}
+    run = sd["ConvScan_0"]
+    for i, idx in enumerate(_VGG_RUN):
+        for kind, sub in run.items():  # Conv_0 and/or BatchNorm_0
+            out[f"{kind[:-2]}_{idx}"] = _tree_slice(sub, i)
+    return out
+
+
+def _vgg_layers_to_scan(sd: dict) -> dict:
+    kinds = [k for k in ("Conv", "BatchNorm")
+             if f"{k}_{_VGG_RUN[0]}" in sd]
+    names = {f"{k}_{i}" for k in kinds for i in _VGG_RUN}
+    out = {k: v for k, v in sd.items() if k not in names}
+    out["ConvScan_0"] = {
+        f"{k}_0": _tree_stack([sd[f"{k}_{i}"] for i in _VGG_RUN])
+        for k in kinds}
+    return out
+
+
+# -- inception: inception_scan <-> inception_blocks (C_1/C_2 pair) --
+
+_INC_RUN = ("InceptionC_1", "InceptionC_2")
+
+
+def _inception_scan_to_blocks(sd: dict) -> dict:
+    out = {k: v for k, v in sd.items() if k != "InceptionCScan_0"}
+    stacked = sd["InceptionCScan_0"]["block"]
+    for i, name in enumerate(_INC_RUN):
+        out[name] = _tree_slice(stacked, i)
+    return out
+
+
+def _inception_blocks_to_scan(sd: dict) -> dict:
+    out = {k: v for k, v in sd.items() if k not in _INC_RUN}
+    out["InceptionCScan_0"] = {
+        "block": _tree_stack([sd[n] for n in _INC_RUN])}
+    return out
+
+
+_CONVERTERS = {
+    ("scan", "blocks"): _vit_scan_to_blocks,
+    ("blocks", "scan"): _vit_blocks_to_scan,
+    ("dense_scan", "dense_layers"): _dense_scan_to_layers,
+    ("dense_layers", "dense_scan"): _dense_layers_to_scan,
+    ("vgg_scan", "vgg_layers"): _vgg_scan_to_layers,
+    ("vgg_layers", "vgg_scan"): _vgg_layers_to_scan,
+    ("inception_scan", "inception_blocks"): _inception_scan_to_blocks,
+    ("inception_blocks", "inception_scan"): _inception_blocks_to_scan,
+}
+
+
+def convert_layout(tree, target: str):
+    """Recursively convert every convertible subtree of ``tree`` (a
+    checkpoint state dict: params, batch_stats, AND the optimizer
+    moments mirroring the params structure) to ``target``.  Subtrees
+    already in the target layout — and unrelated leaves — pass through
+    untouched; an impossible (cross-family) conversion raises."""
+    if target not in KNOWN_LAYOUTS:
+        raise ValueError(f"unknown layout {target!r}")
+    layout = params_layout(tree)
+    if layout == target:
+        return tree
+    if layout is not None:
+        if layout in ("stacked", "blocks") \
+                and target in ("stacked", "blocks"):
+            return vit_pipeline.convert_layout(tree, target)
+        if layout in _VIT_FAMILY and target in _VIT_FAMILY:
+            # transitive via 'blocks' (scan <-> stacked)
+            mid = tree
+            if layout == "stacked":
+                mid = vit_pipeline.convert_layout(tree, "blocks")
+            elif layout == "scan":
+                mid = _vit_scan_to_blocks(tree)
+            if target == "blocks":
+                return mid
+            return (_vit_blocks_to_scan(mid) if target == "scan"
+                    else vit_pipeline.convert_layout(mid, "stacked"))
+        conv = _CONVERTERS.get((layout, target))
+        if conv is None:
+            raise ValueError(
+                f"cannot convert a {layout!r}-layout tree to {target!r} "
+                "(different model families)")
+        return conv(tree)
+    if isinstance(tree, dict):
+        return {k: convert_layout(v, target) for k, v in tree.items()}
+    return tree
+
+
+def scan_layout_for(layout: Optional[str]) -> Optional[str]:
+    """The scanned twin of a plain layout (and vice versa); None when
+    the layout has no twin."""
+    if layout in ("blocks", "stacked"):
+        return "scan"
+    if layout == "scan":
+        return "blocks"
+    return _PAIRS.get(layout)

@@ -22,15 +22,33 @@ _VGG11 = (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M")
 class VGG11BN(nn.Module):
     num_classes: int = 10
     dtype: Any = jnp.bfloat16
+    # --scan-layers: the one homogeneous conv run (the trailing 512->512
+    # pair, historical names Conv_6/Conv_7) runs under lax.scan as
+    # ConvScan_0 (models/scan.py); earlier convs keep their exact names.
+    # Checkpoints convert across the flag ('vgg_scan' <-> 'vgg_layers').
+    scan_layers: bool = False
+
+    # index of the first conv of the scannable homogeneous run, and its
+    # length, within _VGG11's conv sequence
+    _SCAN_START, _SCAN_LEN = 6, 2
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
+        conv_idx = 0
         for v in _VGG11:
             if v == "M":
                 # select-and-scatter-free backward (ops/pooling.py)
                 x = max_pool_2x2(x)
-            else:
+                continue
+            if self.scan_layers and conv_idx == self._SCAN_START:
+                from . import scan
+
+                x = scan.scan_vgg_run(self._SCAN_LEN, v, self.dtype,
+                                      train, name="ConvScan_0")(x)
+            elif not (self.scan_layers
+                      and self._SCAN_START < conv_idx
+                      < self._SCAN_START + self._SCAN_LEN):
                 # bias kept despite the following BN: torchvision's
                 # make_layers leaves Conv2d bias on in vgg11_bn, and exact
                 # param/state_dict parity matters for pretrained loading.
@@ -39,6 +57,7 @@ class VGG11BN(nn.Module):
                 x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                                  dtype=self.dtype)(x)
                 x = nn.relu(x)
+            conv_idx += 1
         x = adaptive_avg_pool(x, 7)
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))

@@ -119,6 +119,11 @@ class ViT(nn.Module):
     # backward, keeping matmul outputs (the MXU work is not recomputed,
     # only the cheap elementwise/normalization ops are).
     remat: bool = False
+    # --scan-layers: run all ``depth`` blocks under one lax.scan with
+    # block params stacked on a leading (depth,) axis — O(1) HLO in
+    # depth instead of O(depth) (models/scan.py; checkpoints convert
+    # across the flag via the 'scan' <-> 'blocks' layout pair).
+    scan_layers: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -141,13 +146,26 @@ class ViT(nn.Module):
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (1, gh * gw, self.dim), jnp.float32)
         x = x + pos.astype(self.dtype)
-        for i in range(self.depth):
-            x = block_cls(self.dim, self.heads, self.mlp_ratio,
-                          self.dtype, attn_fn, self.tp_constrain,
-                          moe_experts=self.moe_experts,
-                          moe_capacity_factor=self.moe_capacity_factor,
-                          moe_constrain=self.moe_constrain,
-                          name=f"block{i}")(x, train)
+        if self.scan_layers:
+            from . import scan
+
+            x = scan.scan_run(
+                block_cls, self.depth,
+                dict(dim=self.dim, heads=self.heads,
+                     mlp_ratio=self.mlp_ratio, dtype=self.dtype,
+                     attention_fn=attn_fn, tp_constrain=self.tp_constrain,
+                     moe_experts=self.moe_experts,
+                     moe_capacity_factor=self.moe_capacity_factor,
+                     moe_constrain=self.moe_constrain),
+                train, name="blocks")(x)
+        else:
+            for i in range(self.depth):
+                x = block_cls(self.dim, self.heads, self.mlp_ratio,
+                              self.dtype, attn_fn, self.tp_constrain,
+                              moe_experts=self.moe_experts,
+                              moe_capacity_factor=self.moe_capacity_factor,
+                              moe_constrain=self.moe_constrain,
+                              name=f"block{i}")(x, train)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         x = jnp.mean(x, axis=1)  # mean-pool tokens
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)

@@ -8,10 +8,20 @@ Variants:
   fastpool+pregather
 
 Each runs the same resident cnn/b64 epoch scan, steady-state timed.
+
+Grid mode (``--grid``): the --remat blocks x batch-size sweep on a
+repeated-block model — remat trades recompute for activation memory,
+so its payoff only shows against the batch sizes it unlocks; one cell
+in isolation answers nothing.  Every row is a full bench.bench_ours
+measurement stamped with bench.provenance_block (fresh flag, device,
+git sha, timestamp) so a replayed grid can't masquerade as current.
+``--scan-layers`` runs the same grid with the lax.scan block form (the
+remat-inside-scan composition).  Output: one JSON document on stdout.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 import time
@@ -221,7 +231,64 @@ def measure(variant: str) -> float:
     return per_step
 
 
+def run_grid(argv: list) -> None:
+    import argparse
+
+    from bench import bench_ours, provenance_block
+
+    p = argparse.ArgumentParser(prog="exp_step_opts.py --grid")
+    p.add_argument("--model", default="vit",
+                   help="a REMAT_BLOCK_MODELS member (vit/densenet/"
+                        "inception)")
+    p.add_argument("--batches", type=int, nargs="+",
+                   default=[64, 128, 256])
+    p.add_argument("--steps", type=int, default=8,
+                   help="steps per measured dispatch (short grid cells, "
+                        "not the 12-epoch headline fusion)")
+    p.add_argument("--scan-layers", action="store_true",
+                   help="run the grid on the lax.scan block form "
+                        "(remat-inside-scan composition)")
+    args = p.parse_args(argv)
+
+    # CPU cells run f32 (bf16 is software-emulated off-TPU and would
+    # only measure the emulation); TPU cells keep the product default.
+    half_precision = jax.default_backend() == "tpu"
+    rows = {}
+    for remat in ("none", "blocks"):
+        for batch in args.batches:
+            key = f"{args.model}_b{batch}_remat_{remat}" \
+                + ("_scan" if args.scan_layers else "")
+            try:
+                row = bench_ours(
+                    batch, args.steps, args.model,
+                    num_train=max(batch * args.steps, 512),
+                    half_precision=half_precision, remat=remat,
+                    scan_layers=args.scan_layers)
+            except Exception as e:
+                # an OOM cell IS the grid's answer for that batch size:
+                # record it as a row, keep sweeping
+                rows[key] = {"error": f"{type(e).__name__}: {e}",
+                             **provenance_block(fresh=True)}
+                print(f"{key}: FAILED ({type(e).__name__})",
+                      file=sys.stderr, flush=True)
+                continue
+            rows[key] = {**row, **provenance_block(fresh=True)}
+            print(f"{key}: {row['samples_per_sec_per_chip']:,.0f} "
+                  f"samples/s/chip, compile {row['compile_warmup_s']}s",
+                  file=sys.stderr, flush=True)
+    print(json.dumps({"grid": rows,
+                      "config": {"model": args.model,
+                                 "batches": args.batches,
+                                 "steps": args.steps,
+                                 "scan_layers": args.scan_layers}}),
+          flush=True)
+
+
 def main():
+    if "--grid" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--grid"]
+        run_grid(argv)
+        return
     # correctness first: fast pool == nn.max_pool fwd+bwd (no ties in
     # random data; tie case checked in the real unit test later)
     x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 8, 16))

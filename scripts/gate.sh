@@ -11,6 +11,10 @@
 #   2. canary tests (~4.5 min on this single-core host): the components a
 #      sharding/engine change can break — pipeline schedule + numerics,
 #      sharded==big-batch equivalence, engine mechanics, driver entry
+#   2b. scan gate: --scan-layers numerics (vit + densenet grads allclose
+#      vs the unrolled loop), bidirectional cross-layout orbax restore,
+#      >=3x densenet HLO-instruction reduction — see scripts/scan_gate.py
+#      and README "Input pipelining & scan-over-layers"
 #   3. transfer-guard smoke: one CPU streaming epoch with device->host
 #      syncs disallowed outside the sanctioned per-epoch points — the
 #      runtime sanitizer for the paper's per-batch .item() bug class
@@ -90,6 +94,9 @@ python scripts/check_bench.py
 
 echo "== gate: overlap regression (telemetry) =="
 env -u XLA_FLAGS -u JAX_PLATFORMS python scripts/overlap_gate.py
+
+echo "== gate: scan-layers (numerics / checkpoints / compile cost) =="
+env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/scan_gate.py
 
 echo "== gate: transfer-guard smoke (runtime sanitizer) =="
 env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/graftlint.py --smoke

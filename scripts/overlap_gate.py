@@ -12,6 +12,14 @@ regressed:
     (compile/warmup_s, compile/cache_hit) or the split checkpoint spans
     (ckpt_save_blocking / ckpt_save_background).
 
+A second leg proves --device-prefetch still overlaps: the same canned
+stall (an artificially slow host gather under a busy consumer) is run
+with the transfer thread off and on, and the consumer's measured
+blocking time (``data/wait_s`` sync vs ``data/device_wait_s``
+prefetched) must drop STRICTLY — same shape as
+tests/test_device_prefetch.py's unit check, but through the real
+ShardedLoader + telemetry stack this gate owns.
+
 CPU-only (the virtual test mesh) and ~1 min — runs in the gate's canary
 tier, before any snapshot.
 """
@@ -20,8 +28,10 @@ import json
 import os
 import sys
 import tempfile
+import time
 
 MAX_STARVED_FRACTION = 0.34
+PREFETCH_WAIT_RATIO = 0.5  # prefetched wait must be < half the sync wait
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -67,6 +77,8 @@ def main() -> int:
             problems.append(f"missing {span} span (--ckpt-async "
                             f"telemetry broken)")
 
+    problems += _device_prefetch_leg()
+
     report = telemetry.report(rsl)
     for p in problems:
         print(f"PROBLEM: {p}", file=sys.stderr)
@@ -74,8 +86,56 @@ def main() -> int:
         print(report, file=sys.stderr)
         return 1
     print(f"overlap gate OK: {int(starved)}/{int(batches)} starved steps, "
-          f"compile + ckpt gauges present")
+          f"compile + ckpt gauges present, device-prefetch overlap holds")
     return 0
+
+
+def _device_prefetch_leg() -> list:
+    """Canned stall A/B: --device-prefetch 2 must cut the consumer's
+    blocking time vs the synchronous path on an identical slow-gather /
+    busy-consumer run (byte-identical batch stream either way — the
+    value equality is tier-1's test_device_prefetch)."""
+    from distributedpytorch_tpu import runtime, telemetry
+    from distributedpytorch_tpu.data.datasets import Split
+    from distributedpytorch_tpu.data.io import make_synthetic
+    from distributedpytorch_tpu.data.pipeline import ShardedLoader
+
+    delay = 0.004
+
+    def measure(depth: int) -> float:
+        tr_x, tr_y, _, _ = make_synthetic(num_train=256, num_test=8,
+                                          image_size=28, channels=1,
+                                          seed=0)
+        loader = ShardedLoader(Split(tr_x, tr_y), runtime.make_mesh(),
+                               batch_per_replica=2, shuffle=True, seed=7,
+                               prefetch=2, device_prefetch=depth)
+        orig = loader._host_batch
+
+        def slow(per_rank, step):
+            time.sleep(delay)  # the canned stall: slow host gather
+            return orig(per_rank, step)
+
+        loader._host_batch = slow
+        rsl = tempfile.mkdtemp(prefix=f"overlap_gate_dp{depth}_")
+        tel = telemetry.configure(rsl, enabled=True, rank=0)
+        try:
+            for _ in loader.epoch(0):
+                time.sleep(delay)  # busy consumer: compute to hide under
+            name = "data/device_wait_s" if depth else "data/wait_s"
+            return tel.counter(name).value
+        finally:
+            tel.close()
+            telemetry._active = telemetry.Telemetry(enabled=False)
+
+    wait_off = measure(0)
+    wait_on = measure(2)
+    print(f"device-prefetch leg: consumer wait {wait_off:.3f}s sync -> "
+          f"{wait_on:.3f}s with --device-prefetch 2", file=sys.stderr)
+    if wait_on >= wait_off * PREFETCH_WAIT_RATIO:
+        return [f"--device-prefetch overlap regressed: prefetched wait "
+                f"{wait_on:.3f}s not below {PREFETCH_WAIT_RATIO:.0%} of "
+                f"sync wait {wait_off:.3f}s"]
+    return []
 
 
 if __name__ == "__main__":

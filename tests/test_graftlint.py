@@ -891,6 +891,81 @@ def test_wall_clock_rationale_comment_silences(tmp_path):
                  rule="wall-clock-in-measurement") == []
 
 
+# -- rule 14: blocking-h2d-in-step-loop --------------------------------
+
+_H2D_BAD = """
+    import jax
+
+    def drive(loader, engine, state, sharding):
+        for images, labels, valid in loader.epoch(0):
+            images = jax.device_put(images, sharding)
+            state, metrics = engine.train_step(state, images, labels,
+                                               valid)
+        return state
+"""
+
+_H2D_GOOD = """
+    import jax
+
+    def drive(loader, engine, state, sharding):
+        # per-epoch transfer outside the step loop is fine
+        table = jax.device_put(loader.split.images, sharding)
+        for step in range(loader.batches_per_epoch):
+            state, metrics = engine.train_step(state, table, step)
+        return state
+"""
+
+
+def test_h2d_device_put_in_step_loop_positive(tmp_path):
+    found = _lint(tmp_path, {"engine.py": _H2D_BAD},
+                  rule="blocking-h2d-in-step-loop")
+    assert len(found) == 1
+    assert "device-prefetch" in found[0].message
+
+
+def test_h2d_block_until_ready_in_step_loop_positive(tmp_path):
+    src = """
+        import jax
+
+        def drive(loader, engine, state):
+            for batch in loader.epoch(0):
+                state, m = engine.train_step(state, *batch)
+                jax.block_until_ready(m)
+            return state
+    """
+    found = _lint(tmp_path, {"cli.py": src},
+                  rule="blocking-h2d-in-step-loop")
+    assert len(found) == 1
+    assert "stalls the step loop" in found[0].message
+
+
+def test_h2d_per_epoch_transfer_negative(tmp_path):
+    assert _lint(tmp_path, {"engine.py": _H2D_GOOD},
+                 rule="blocking-h2d-in-step-loop") == []
+
+
+def test_h2d_rationale_comment_silences(tmp_path):
+    src = """
+        import jax
+
+        def drive(loader, engine, state, sharding):
+            for images, labels, valid in loader.epoch(0):
+                # warm-start probe: ONE inline put, measured on purpose
+                images = jax.device_put(images, sharding)
+                state, _ = engine.train_step(state, images, labels, valid)
+            return state
+    """
+    assert _lint(tmp_path, {"engine.py": src},
+                 rule="blocking-h2d-in-step-loop") == []
+
+
+def test_h2d_non_step_module_negative(tmp_path):
+    # the data pipeline is the transfer OWNER — its device_puts are the
+    # fix, not the finding; only step-driving modules are in scope
+    assert _lint(tmp_path, {"pipeline.py": _H2D_BAD},
+                 rule="blocking-h2d-in-step-loop") == []
+
+
 # -- CLI contract ------------------------------------------------------
 
 def test_repo_lints_clean_via_run_cli(capsys):
