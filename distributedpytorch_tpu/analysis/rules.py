@@ -1399,6 +1399,115 @@ class BlockingH2dInStepLoop(Rule):
                             f"is the point here")
 
 
+# -- 15. unbounded-queue-in-server ------------------------------------
+
+class UnboundedQueueInServer(Rule):
+    """A server that queues without a bound turns overload into
+    unbounded memory growth and seconds-later timeouts for EVERYONE,
+    instead of an immediate 503 for the overflow — the backpressure
+    contract the serving tier is built on (serving/batcher.py sheds at
+    ``--serve-queue``; ISSUE 15).  Two shapes in serving/request-handler
+    modules are findings:
+
+      * a ``queue.Queue()`` / ``SimpleQueue()`` / ``LifoQueue()``
+        constructed without a positive maxsize — the stdlib default is
+        infinite;
+      * an ``.append()`` / ``.appendleft()`` / ``.put()`` onto a
+        collection inside a ``while True:`` producer loop with no
+        ``len()``-based guard anywhere in the loop body — the
+        accumulate-forever shape.
+
+    Deliberate exceptions carry a rationale comment on the line or the
+    line above (same contract as wall-clock-in-measurement): e.g. an
+    unbounded deque whose bound is enforced at an explicit admit()
+    check so overflow is ANSWERED rather than silently dropped."""
+
+    name = "unbounded-queue-in-server"
+    description = ("queue.Queue()/producer-loop append without a "
+                   "maxsize or backpressure bound in serving/request-"
+                   "handler code — shed load with an answer, never "
+                   "queue unboundedly")
+    TARGET_BASENAMES = {"server.py", "batcher.py", "handler.py",
+                        "handlers.py"}
+    QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue"}
+    APPENDS = {"append", "appendleft", "put", "put_nowait"}
+
+    _has_rationale = BlockingH2dInStepLoop._has_rationale
+
+    def _targets(self, mod: Module) -> bool:
+        return (mod.basename in self.TARGET_BASENAMES
+                or "serving" in mod.rel.replace("\\", "/").split("/")[:-1])
+
+    def _unbounded_ctor(self, call: ast.Call) -> bool:
+        """queue.Queue() with no positive bound.  SimpleQueue has no
+        maxsize parameter at all — it is always unbounded."""
+        cn = call_name(call)
+        if last_seg(cn) not in self.QUEUE_CTORS:
+            return False
+        if root_seg(cn) not in ("queue", "multiprocessing", "mp", ""):
+            return False
+        if last_seg(cn) == "SimpleQueue":
+            return True
+        bound = call.args[0] if call.args else kwarg(call, "maxsize")
+        if bound is None:
+            return True
+        # maxsize=0 and maxsize=-1 are the stdlib's spellings of
+        # "infinite"; any other literal/expression counts as a bound.
+        return (isinstance(bound, ast.Constant)
+                and isinstance(bound.value, int) and bound.value <= 0)
+
+    def _loop_has_shed_guard(self, loop: ast.While) -> bool:
+        """A len()-based comparison anywhere in the loop body: the
+        producer checks how much is queued before appending."""
+        for n in ast.walk(loop):
+            if isinstance(n, ast.Call) and call_name(n) == "len":
+                return True
+            if isinstance(n, ast.Attribute) and n.attr in ("qsize",
+                                                           "full",
+                                                           "depth"):
+                return True
+        return False
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            if not self._targets(mod):
+                continue
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) \
+                        and self._unbounded_ctor(node):
+                    if self._has_rationale(mod, node.lineno):
+                        continue
+                    yield self.finding(
+                        mod, node.lineno,
+                        f"unbounded {call_name(node)}() in server code: "
+                        f"the stdlib default maxsize is infinite, so "
+                        f"overload becomes memory growth + mass "
+                        f"timeouts — pass a maxsize and shed overflow "
+                        f"with an answer (503), or comment why this "
+                        f"queue is bounded elsewhere")
+                    continue
+                if not (isinstance(node, ast.While)
+                        and isinstance(node.test, ast.Constant)
+                        and node.test.value is True):
+                    continue
+                if self._loop_has_shed_guard(node):
+                    continue
+                for call in walk_calls(node):
+                    if not isinstance(call.func, ast.Attribute) \
+                            or call.func.attr not in self.APPENDS:
+                        continue
+                    if self._has_rationale(mod, call.lineno):
+                        continue
+                    yield self.finding(
+                        mod, call.lineno,
+                        f".{call.func.attr}() in a 'while True' "
+                        f"producer loop with no len()/qsize()/full() "
+                        f"check: requests accumulate without bound "
+                        f"under overload — check the depth and shed "
+                        f"(answer 503) before enqueueing, or comment "
+                        f"why growth is bounded here")
+
+
 RULES = (
     HostSyncInStepLoop(),
     TraceImpurity(),
@@ -1414,6 +1523,7 @@ RULES = (
     CollectiveInCleanup(),
     WallClockInMeasurement(),
     BlockingH2dInStepLoop(),
+    UnboundedQueueInServer(),
 )
 
 RULES_BY_NAME = {r.name: r for r in RULES}

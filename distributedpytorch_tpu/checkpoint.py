@@ -933,6 +933,37 @@ def load_checkpoint(path: str, state: TrainState,
         return _load_checkpoint_inner(path, state, restore_optimizer)
 
 
+def restore_for_serving(path: str, state: TrainState
+                        ) -> Tuple[TrainState, int]:
+    """The serving tier's restore (cli.run_serve): any lineage-verified
+    checkpoint, any ``params_layout`` — the regular load path already
+    checksums against the lineage ledger and converts scan/blocks/
+    pipeline layouts into the template's.  Serving never wants the
+    optimizer state (a replica holds params + batch_stats only), and it
+    records WHAT it is serving as a ``serve_restore`` telemetry event —
+    the audit line tying every answered request back to a checkpoint.
+    Returns (state, last_trained_epoch)."""
+    restored, next_epoch, _best = load_checkpoint(
+        path, state, restore_optimizer=False)
+    layout = None
+    try:
+        with runtime.sanctioned_host_transfer():
+            layout = model_scan.params_layout(
+                serialization.to_state_dict(
+                    jax.device_get(gather_replicated(restored))).get(
+                        "params"))
+    except Exception:
+        pass  # the layout tag is audit metadata, never load-blocking
+    telemetry.get().event("serve_restore",
+                          file=os.path.basename(path),
+                          epoch=next_epoch - 1,
+                          layout=layout or "unknown")
+    logging.info(f"serving checkpoint {path} "
+                 f"(trained through epoch {next_epoch - 1}, "
+                 f"layout {layout or 'unknown'})")
+    return restored, next_epoch - 1
+
+
 def _load_checkpoint_inner(path: str, state: TrainState,
                            restore_optimizer: bool
                            ) -> Tuple[TrainState, int, float]:

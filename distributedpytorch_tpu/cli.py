@@ -1013,7 +1013,8 @@ def _train_world(cfg: Config, model_name: str, dataset: Dataset, mesh,
                              start_time, world, shutdown, saver)
 
 
-def _elastic_reconfigure(cfg: Config, tel, saver, grow: bool = False):
+def _elastic_reconfigure(cfg: Config, tel, saver, grow: bool = False,
+                         purpose: str = "train"):
     """Shrink into the surviving world — or grow into the admitted one —
     and return the new mesh.
 
@@ -1041,12 +1042,13 @@ def _elastic_reconfigure(cfg: Config, tel, saver, grow: bool = False):
         cfg.rsl_path)
     info = elastic.reconfigure(elastic_dir, old_rank, old_world,
                                grow=grow, target=cfg.elastic_target,
-                               min_world=cfg.elastic_min_world)
+                               min_world=cfg.elastic_min_world,
+                               purpose=purpose)
     tel.event("elastic/reconfigure", generation=info["generation"],
               old_world=old_world, new_world=info["new_world"],
               old_rank=old_rank, new_rank=info["new_rank"],
               grow=grow, joined=info.get("joiners", []),
-              coordinator=info["coordinator"])
+              coordinator=info["coordinator"], purpose=purpose)
     tel.gauge("elastic/world_size").set(info["new_world"])
     tel.flush()
     flightrec.get().record_event("elastic_reconfigure",
@@ -1410,6 +1412,250 @@ def run_test(cfg: Config) -> dict:
     return {"test_loss": loss, "test_acc": acc, "model_name": model_name}
 
 
+def _serve_warmup(cfg: Config, engine: Engine, state, mesh, buckets,
+                  sample_shape, sample_dtype) -> None:
+    """AOT-compile the predict program for every bucket on the serving
+    menu BEFORE the port answers its first request, so no request-path
+    batch shape ever compiles.  Same contract as --aot-warmup: the time
+    is a recorded ``compile`` goodput category (restart-to-first-
+    response is bounded and attributed), each program's cost analysis
+    lands in costs.json, and with the persistent compilation cache a
+    replica restart turns the whole menu into disk hits."""
+    tel = telemetry.get()
+    hits_before = runtime.compilation_cache_hits()
+    t0 = time.perf_counter()
+    n_dev = int(mesh.devices.size)
+    for b in buckets:
+        # A bucket that divides over the local devices is served
+        # sharded; the rest (b < n_dev, or indivisible) replicated —
+        # the same rule the infer closure applies per batch.
+        sh = (runtime.data_sharding(mesh) if b % n_dev == 0
+              else runtime.replicated_sharding(mesh))
+        costs.record(f"predict_b{b}", engine.predict_step.lower(
+            state, _sds((b,) + tuple(sample_shape), sample_dtype,
+                        sh)).compile(), hlo=True)
+    warmup_s = time.perf_counter() - t0
+    goodput.get().add("compile", warmup_s)
+    hit = runtime.compilation_cache_hits() > hits_before
+    tel.gauge("compile/warmup_s").set(warmup_s)
+    tel.gauge("compile/cache_hit").set(1.0 if hit else 0.0)
+    if runtime.is_main():
+        costs.save(cfg.rsl_path)
+    logging.info(f"serve: {len(buckets)} bucket programs "
+                 f"({','.join(str(b) for b in buckets)}) compiled in "
+                 f"{warmup_s:.2f}s "
+                 f"({'persistent-cache hit' if hit else 'cold'})")
+
+
+def _serve_build_replica(cfg: Config, model_name: str, dataset, buckets,
+                         sample_shape, sample_dtype):
+    """Build THIS replica's predict closure for the current elastic
+    generation: local mesh -> engine -> lineage-verified restore (any
+    params_layout) -> replicated placement -> per-bucket AOT warmup.
+    Called at startup and again after every reconfigure — the rebuild
+    re-restores the checkpoint and re-warms the menu (persistent-cache
+    hits), so surviving a rank loss costs seconds, not a recompile."""
+    mesh = runtime.make_serve_mesh()
+    engine = _build_engine(cfg, model_name, dataset, steps_per_epoch=1,
+                           mesh=mesh)
+    template = engine.init_state(utils.root_key(cfg.seed))
+    if os.path.isdir(cfg.checkpoint_file):
+        # orbax: restore straight into the final layout (see run_train)
+        template = _place_state(template, mesh, cfg)
+    state, _epoch = ckpt.restore_for_serving(cfg.checkpoint_file,
+                                             template)
+    state = _place_state(state, mesh, cfg)
+    _serve_warmup(cfg, engine, state, mesh, buckets, sample_shape,
+                  sample_dtype)
+    n_dev = int(mesh.devices.size)
+
+    def infer(arr):
+        sh = (runtime.data_sharding(mesh) if arr.shape[0] % n_dev == 0
+              else runtime.replicated_sharding(mesh))
+        labels, confs = engine.predict_step(state,
+                                            jax.device_put(arr, sh))
+        # The answer must leave the device — this is the one sanctioned
+        # device->host read on the serving path.
+        with runtime.sanctioned_host_transfer():
+            return np.asarray(labels), np.asarray(confs)
+
+    return infer
+
+
+def run_serve(cfg: Config) -> dict:
+    """``main.py serve``: batched, elastic inference from a checkpoint
+    (ISSUE 15).  Setup mirrors run_test; the loop is serving/server.py's
+    micro-batch driver wrapped in run_train's elastic-reconfigure shape:
+    one iteration of the while loop per collective world."""
+    from . import serving
+
+    if cfg.use_pretrained:
+        raise ValueError(
+            "--use-pretrained is not applicable to the serve subcommand: "
+            "weights come from -f FILE")
+    if cfg.model_parallel > 1 or cfg.tensor_parallel \
+            or cfg.pipeline_parallel or cfg.seq_parallel > 1:
+        # Replicas shard at the REQUEST level over replica-local meshes
+        # (runtime.make_serve_mesh): there are no cross-host collectives
+        # to lay a model axis over.  Pipeline/scan-trained checkpoints
+        # still serve — the restore converts them to the plain layout.
+        raise ValueError(
+            "serve runs replica-local data-parallel inference; "
+            "--model-parallel/--tensor-parallel/--pipeline-parallel/"
+            "--seq-parallel do not apply (model-parallel-trained "
+            "checkpoints convert at load)")
+    buckets = serving.parse_buckets(cfg.serve_buckets)
+    if cfg.serve_queue < max(buckets):
+        raise ValueError(
+            f"--serve-queue {cfg.serve_queue} is smaller than the "
+            f"largest bucket {max(buckets)}: the queue could never "
+            "fill a full batch")
+    _validate_ckpt_format(cfg)
+    faults.configure(cfg.fault_plan, cfg.fault_seed,
+                     cfg.retry_max_attempts, cfg.retry_base_delay,
+                     cfg.retry_timeout)
+    join_info = None
+    if cfg.elastic_join:
+        if not cfg.elastic:
+            raise ValueError(
+                "--elastic-join requires --elastic: a joining replica "
+                "becomes a normal elastic member and must keep "
+                "reconfiguring with its world")
+        join_info = runtime.join_distributed(
+            cfg.elastic_dir or elastic.default_elastic_dir(cfg.rsl_path))
+    else:
+        runtime.initialize_distributed(elastic=cfg.elastic)
+    if cfg.elastic:
+        elastic.evaluate_join_policy(1, [], cfg.elastic_target,
+                                     cfg.elastic_min_world)
+    _validate_precision(cfg)
+    utils.initialize_logging(cfg.rsl_path, cfg.log_file,
+                             truncate=runtime.is_main())
+    # Telemetry is ALWAYS on in serve mode: the latency histograms and
+    # queue gauges are the tier's operational surface (/metrics renders
+    # only enabled telemetry), not an opt-in debugging aid.
+    tel = telemetry.configure(cfg.rsl_path, True)
+    flightrec.configure(cfg.rsl_path, cfg.flightrec,
+                        rank=runtime.process_index(),
+                        ring_size=cfg.flightrec_ring)
+    goodput.configure(cfg.rsl_path, True,
+                      rank=runtime.process_index(),
+                      world=runtime.process_count())
+    if cfg.metrics_port:
+        goodput.start_exporter(cfg.metrics_port,
+                               rank=runtime.process_index(),
+                               world_size_fn=runtime.world_size,
+                               generation_fn=elastic.generation)
+    costs.reset()
+    runtime.configure_compilation_cache(cfg.compilation_cache_path())
+    # Bound once from the INITIAL rank and kept for the process
+    # lifetime: ranks renumber at every reconfigure, and a port that
+    # moved with them would break every client mid-incident.
+    port = cfg.serve_port + runtime.process_index()
+    tel.event("run_start", action="serve", dataset=cfg.dataset,
+              world=runtime.world_size(),
+              processes=runtime.process_count(),
+              buckets=list(buckets), port=port)
+    if join_info is not None:
+        tel.event("elastic/join", generation=join_info["generation"],
+                  new_world=join_info["new_world"],
+                  new_rank=join_info["new_rank"],
+                  coordinator=join_info["coordinator"])
+        tel.gauge("elastic/world_size").set(join_info["new_world"])
+        tel.flush()
+    logging.info(f"serve: process {runtime.process_index()}/"
+                 f"{runtime.process_count()}, replica port {port}")
+
+    model_name = ckpt.get_checkpoint_model_name(cfg.checkpoint_file)
+    dataset = load_dataset(cfg.dataset, cfg.data_path, cfg.seed,
+                           debug=cfg.debug, log=runtime.is_main(),
+                           synthetic_fallback=cfg.synthetic_fallback)
+    images = dataset.splits["test"].images
+    sample_shape, sample_dtype = images.shape[1:], images.dtype
+
+    shutdown = utils.GracefulShutdown()
+    tier = None
+    reconfigures = 0
+    try:
+        with shutdown:
+            infer = _serve_build_replica(cfg, model_name, dataset,
+                                         buckets, sample_shape,
+                                         sample_dtype)
+            tier = serving.ServingTier(
+                infer, sample_shape, sample_dtype, buckets,
+                max_queue=cfg.serve_queue,
+                max_latency_s=cfg.serve_max_latency_ms / 1000.0,
+                port=port,
+                request_timeout_s=cfg.serve_request_timeout,
+                max_requests=cfg.serve_max_requests)
+            goodput.set_health_extra(tier.stats)
+            tier.start()
+
+            def health_fn():
+                # The training health boundary verbatim: ONE allgather
+                # for failure + preemption + grow votes, peer-loss ->
+                # WorldChangedError under --elastic, True on clean stop.
+                return _health_boundary(tel, shutdown, 0, None, cfg)
+
+            multi = runtime.process_count() > 1 or cfg.elastic
+            while True:
+                try:
+                    answered = tier.run(
+                        health_fn=health_fn if multi else None,
+                        shutdown=shutdown)
+                    break
+                except elastic.WorldChangedError as e:
+                    grow = bool(getattr(e, "grow", False))
+                    reconfigures += 1
+                    if reconfigures > cfg.max_reconfigures:
+                        raise faults.PeerFailureError(
+                            f"world changed {reconfigures} times, over "
+                            f"the --max-reconfigures "
+                            f"{cfg.max_reconfigures} cap; exiting with "
+                            "the last failure") from e
+                    # Same release discipline as run_train's loop: the
+                    # old replica's closure (engine/state on the dead
+                    # generation's backend) and the exception chain's
+                    # frames must be droppable before the reconfigure
+                    # parks the old world.
+                    infer = None
+                    tier.set_infer(None)
+                    exc = e
+                    while exc is not None:
+                        exc.__traceback__ = None
+                        exc = exc.__cause__ or exc.__context__
+                # Reconfigure OUTSIDE the except block (sys.exc_info
+                # pins the traceback until the block exits).  The HTTP
+                # listener stays up through the whole window: requests
+                # keep admitting into the bounded queue and are
+                # answered by the rebuilt replica.
+                with goodput.get().timed("elastic_reconfigure"):
+                    _elastic_reconfigure(cfg, tel, None, grow,
+                                         purpose="serve")
+                    infer = _serve_build_replica(cfg, model_name,
+                                                 dataset, buckets,
+                                                 sample_shape,
+                                                 sample_dtype)
+                    tier.set_infer(infer)
+                logging.info(
+                    f"serve: replica rebuilt for generation "
+                    f"{elastic.generation()}; resuming with "
+                    f"{tier.batcher.depth()} queued requests")
+        logging.info(f"serve: stopped after answering {answered} "
+                     f"requests")
+        return {"answered": answered, "port": port,
+                "model_name": model_name}
+    finally:
+        if tier is not None:
+            tier.close()
+        flightrec.get().close(
+            "crash" if sys.exc_info()[0] is not None else "run_end")
+        goodput.stop_exporter()
+        goodput.get().close()
+        tel.close()
+        runtime.reset_compilation_cache()
+
+
 def main(argv=None) -> int:
     cfg = config_from_argv(argv)
     if cfg.action == "lint":
@@ -1484,6 +1730,8 @@ def main(argv=None) -> int:
     try:
         if cfg.action == "train":
             run_train(cfg)
+        elif cfg.action == "serve":
+            run_serve(cfg)
         else:
             run_test(cfg)
     except ValueError as e:  # ref style: log and exit (classif.py:119,130)

@@ -62,7 +62,7 @@ DATASET_CHOICES = ("mnist", "fashion_mnist", "cifar10", "synthetic",
 class Config:
     """Everything a run needs; replaces ref config.py + parsed args."""
 
-    action: str = "train"                  # 'train' | 'test'
+    action: str = "train"                  # 'train' | 'test' | 'serve'
     data_path: str = DATA_PATH             # honored (fixes SURVEY defect #1)
     rsl_path: str = RSL_PATH
     log_file: str = LOG_FILE
@@ -284,6 +284,20 @@ class Config:
     # http://0.0.0.0:(metrics_port + rank)/metrics (and /healthz) for the
     # life of the run.  0 disables the exporter.
     metrics_port: int = 0
+    # 'serve' subcommand (serving/, ISSUE 15): each process answers
+    # POST /predict on serve_port + its INITIAL rank (bound once; kept
+    # across elastic reconfigures).  serve_buckets is the fixed menu of
+    # AOT-compiled batch sizes; serve_max_latency_ms the micro-batcher
+    # flush deadline; serve_queue the bounded queue depth past which
+    # requests are shed with a 503; serve_request_timeout the handler-
+    # side wait before a 504; serve_max_requests stops the driver after
+    # answering N requests (0 = serve forever; the gates use N).
+    serve_port: int = 8100
+    serve_buckets: str = "1,4,16,64"
+    serve_max_latency_ms: float = 20.0
+    serve_queue: int = 256
+    serve_request_timeout: float = 30.0
+    serve_max_requests: int = 0
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -637,6 +651,50 @@ def build_parser() -> argparse.ArgumentParser:
                         dest="checkpointFile", default=None, required=True,
                         help="model file")
 
+    # Serving tier (serving/, ISSUE 15): batched, elastic inference
+    # from a lineage-verified checkpoint.  Shares the full common flag
+    # set — the serve path reuses the dataset spec (input shape /
+    # normalization), model zoo, mesh, elastic and fault machinery.
+    p_serve = sub.add_parser(
+        "serve", help="serve a trained checkpoint: micro-batched "
+                      "inference over HTTP with AOT-warmed batch "
+                      "buckets, bounded-queue backpressure, and "
+                      "elastic replica membership")
+    _common_args(p_serve)
+    p_serve.add_argument("-f", "--file", metavar="file_path", type=str,
+                         dest="checkpointFile", default=None,
+                         required=True,
+                         help="checkpoint to serve (any params_layout; "
+                              "converted at load)")
+    p_serve.add_argument("--serve-port", type=int, default=8100,
+                         dest="servePort", metavar="PORT",
+                         help="HTTP port for this replica's /predict "
+                              "(rank r binds PORT + r; default 8100)")
+    p_serve.add_argument("--serve-buckets", type=str, default="1,4,16,64",
+                         dest="serveBuckets", metavar="B1,B2,...",
+                         help="batch-size buckets to AOT-compile; every "
+                              "micro-batch pads to one of these "
+                              "(default 1,4,16,64)")
+    p_serve.add_argument("--serve-max-latency-ms", type=float,
+                         default=20.0, dest="serveMaxLatencyMs",
+                         metavar="MS",
+                         help="micro-batcher flush deadline: a queued "
+                              "request waits at most this long for "
+                              "batch-mates (default 20)")
+    p_serve.add_argument("--serve-queue", type=int, default=256,
+                         dest="serveQueue", metavar="N",
+                         help="bounded request-queue depth; past it "
+                              "requests are shed with 503 (default 256)")
+    p_serve.add_argument("--serve-request-timeout", type=float,
+                         default=30.0, dest="serveRequestTimeout",
+                         metavar="S",
+                         help="per-request wait before the handler "
+                              "answers 504 (default 30)")
+    p_serve.add_argument("--serve-max-requests", type=int, default=0,
+                         dest="serveMaxRequests", metavar="N",
+                         help="stop after answering N requests "
+                              "(0 = serve forever; gates use this)")
+
     # Offline aggregation — reads RSL_PATH/telemetry/rank*.jsonl written
     # by a --telemetry run; needs none of the train/test flags.
     p_rep = sub.add_parser(
@@ -809,4 +867,11 @@ def config_from_argv(argv=None) -> Config:
         anomaly_min_excess=args.anomalyMinExcess,
         anomaly_capture_steps=args.anomalyCaptureSteps,
         anomaly_max_captures=args.anomalyMaxCaptures,
+        # serve-only flags (defaults when action is train/test)
+        serve_port=getattr(args, "servePort", 8100),
+        serve_buckets=getattr(args, "serveBuckets", "1,4,16,64"),
+        serve_max_latency_ms=getattr(args, "serveMaxLatencyMs", 20.0),
+        serve_queue=getattr(args, "serveQueue", 256),
+        serve_request_timeout=getattr(args, "serveRequestTimeout", 30.0),
+        serve_max_requests=getattr(args, "serveMaxRequests", 0),
     )

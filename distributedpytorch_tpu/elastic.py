@@ -808,23 +808,29 @@ def _rendezvous(elastic_dir: str, gen: int, old_rank: int,
 
 def reconfigure(elastic_dir: str, old_rank: int, old_world: int,
                 grow: bool = False, target: str = "capacity",
-                min_world: int = 1) -> dict:
+                min_world: int = 1, purpose: str = "train") -> dict:
     """Tear down the current generation and join the reconfigured one —
     shrunken after a peer loss, or grown (``grow=True``) after the
     health boundary agreed to admit join claims.
 
     Returns ``{"generation", "members", "joiners", "coordinator",
-    "new_rank", "new_world"}``.  The collective-runtime re-init (the
-    transient-failure-prone part: a follower can race the new
-    coordinator's service coming up) runs under the process retry
+    "new_rank", "new_world", "purpose"}``.  The collective-runtime
+    re-init (the transient-failure-prone part: a follower can race the
+    new coordinator's service coming up) runs under the process retry
     policy at fault site ``elastic.reinit`` (``elastic.grow_reinit``
     when growing).
+
+    ``purpose`` tags what the world is FOR ("train" | "serve") in the
+    logs and the returned info: a serving reconfigure answers requests
+    throughout (the queue is host-side and survives), while a training
+    reconfigure rewinds to the epoch boundary — the audit trail must
+    distinguish them.
     """
     global _generation, _reconfigured, _barrier
     gen = _generation + 1
     logging.warning(
         f"ELASTIC: rank {old_rank} reconfiguring "
-        f"({'grow' if grow else 'shrink'}) from world size "
+        f"({'grow' if grow else 'shrink'}, {purpose}) from world size "
         f"{old_world} (generation {gen})")
     # Tear the failed generation down BEFORE the rendezvous: closing
     # our gloo sockets is the wake-up signal for any peer still
@@ -885,7 +891,7 @@ def reconfigure(elastic_dir: str, old_rank: int, old_world: int,
         f"({len(joiners)} joined; coordinator {doc['coordinator']})")
     return {"generation": gen, "members": members, "joiners": joiners,
             "coordinator": doc["coordinator"], "new_rank": new_rank,
-            "new_world": new_world}
+            "new_world": new_world, "purpose": purpose}
 
 
 def _reset_for_tests() -> None:

@@ -19,6 +19,13 @@ named sites threaded through the runtime.  Sites currently wired:
   elastic.grow_reinit  elastic.py grown-world re-initialization (both
                    the joiner's connect and the survivors' grow reinit)
   telemetry.write  telemetry.py JSONL writer
+  serve.request    serving/server.py per-request handler entry (an
+                   injected ioerror answers that request with a 500)
+  serve.admit      serving/server.py queue admission (shed-path tests)
+  serve.infer      serving/server.py driver per-micro-batch dispatch —
+                   ioerror fails one batch and the tier keeps serving;
+                   rank_loss vanishes the replica mid-serve (chaos
+                   stage G: survivors must reconfigure and answer)
 
 Plan forms (``--fault-plan``):
 
@@ -93,7 +100,8 @@ KINDS = ("ioerror", "fatal", "preempt", "torn", "stall", "rank_loss",
 
 SITES = ("data.read", "data.host_batch", "ckpt.save", "ckpt.finalize",
          "ckpt.restore", "runtime.init", "elastic.reinit",
-         "elastic.join", "elastic.grow_reinit", "telemetry.write")
+         "elastic.join", "elastic.grow_reinit", "telemetry.write",
+         "serve.request", "serve.infer", "serve.admit")
 
 # Exit code of a rank killed by kind=rank_loss: distinguishable in the
 # harness from a crash (1), a fatal-agreement exit (CHILD_EXIT) and a

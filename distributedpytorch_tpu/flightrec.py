@@ -238,7 +238,12 @@ class FlightRecorder:
                                   f"flightrec-rank{rank}.json")
         self._ring: Deque[Dict[str, Any]] = collections.deque(
             maxlen=max(self.ring_size, 16))
-        self._lock = threading.Lock()
+        # REENTRANT on purpose: the preempt signal handler
+        # (utils.GracefulShutdown) fires record_event() + dump() on the
+        # main thread and may interrupt a frame already inside this
+        # lock (record_step, an anomaly capture) — a plain Lock
+        # self-deadlocks the whole process there.
+        self._lock = threading.RLock()
         self._dump_reasons: List[str] = []
         self.detector: Optional[AnomalyDetector] = None
 

@@ -392,6 +392,8 @@ class MetricsExporter:
         self._generation_fn = generation_fn or (lambda: 0)
         self._lock = threading.Lock()
         self._last_step_mono: Optional[float] = None  # guarded by _lock
+        self._health_extra_fn: Optional[Callable[[], Dict[str, Any]]] \
+            = None
         exporter = self
 
         class _Handler(http.server.BaseHTTPRequestHandler):
@@ -473,13 +475,20 @@ class MetricsExporter:
             generation = int(self._generation_fn())
         except Exception:  # runtime may be mid-reconfigure
             world, generation = -1, -1
-        return {
+        doc = {
             "status": "ok",
             "rank": self.rank,
             "world_size": world,
             "elastic_generation": generation,
             "last_step_age_s": round(age, 3) if age is not None else None,
         }
+        extra = self._health_extra_fn
+        if extra is not None:
+            try:
+                doc["serve"] = extra()
+            except Exception:
+                pass  # a failing stats callback must not break /healthz
+        return doc
 
     def close(self) -> None:
         """Stop serving and release the socket.  Idempotent."""
@@ -519,6 +528,14 @@ def start_exporter(port: int, rank: int = 0,
         logging.info("goodput: serving /metrics and /healthz on :%d",
                      port + rank)
     return _exporter
+
+
+def set_health_extra(fn: Optional[Callable[[], Dict[str, Any]]]) -> None:
+    """Attach an extra payload callable to /healthz (the serving tier
+    reports queue depth + answered count there).  No-op when the
+    exporter is disabled."""
+    if _exporter is not None:
+        _exporter._health_extra_fn = fn
 
 
 def stop_exporter() -> None:

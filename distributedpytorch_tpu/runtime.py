@@ -220,6 +220,20 @@ def make_mesh(data_parallel: Optional[int] = None,
     return Mesh(devs.reshape(dp, model_parallel), (DATA_AXIS, MODEL_AXIS))
 
 
+def make_serve_mesh() -> Mesh:
+    """The serving replica's mesh: THIS process's devices only.
+
+    Request serving shards at the REQUEST level — each replica answers
+    its own HTTP port from its own device set — so the predict program
+    must contain no cross-host collectives: a replica's dispatch
+    cadence stays its own, and a peer dying mid-batch cannot wedge a
+    survivor inside XLA.  The shared jax.distributed world still exists
+    underneath for membership (elastic health agreement, join
+    rendezvous); it just never appears in the inference mesh.
+    """
+    return make_mesh(devices=jax.local_devices())
+
+
 def data_sharding(mesh: Mesh) -> NamedSharding:
     """Batch arrays: sharded along the leading axis over 'data'."""
     return NamedSharding(mesh, P(DATA_AXIS))

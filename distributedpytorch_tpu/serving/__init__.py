@@ -1,0 +1,45 @@
+"""The serving tier: batched, elastic inference over lineage-verified
+checkpoints (``main.py serve`` — ISSUE 15).
+
+The training side of this repo already owns everything a production
+inference tier needs — self-describing checkpoints that convert across
+param layouts at load (checkpoint.py + models/scan.py), AOT compilation
+against the persistent XLA cache (bounded restart-to-first-response),
+an elastic world manager that survives rank loss (elastic.py), and a
+live ``/metrics`` exporter (goodput.py).  This package adds the three
+missing pieces, deliberately JAX-free so the batching logic is unit-
+testable without a backend:
+
+  planner.py   the bucket planner: a fixed menu of AOT-compiled batch
+               sizes (``--serve-buckets``) and the pick-largest-ready /
+               pad-to-smallest decision for a pending queue
+  batcher.py   the dynamic micro-batcher: a BOUNDED request queue that
+               coalesces pending requests into the largest ready bucket
+               under a ``--serve-max-latency-ms`` flush deadline, with
+               explicit backpressure (admit() refuses when full — the
+               HTTP front end turns that into a 503, never unbounded
+               growth)
+  server.py    the replica: a ThreadingHTTPServer front end whose
+               handler threads ONLY validate + enqueue, and a single
+               driver thread that runs the micro-batch loop, calls the
+               injected ``infer_fn`` (the jitted predict program lives
+               in cli.py), and ticks the elastic health boundary
+               between batches
+
+Replica topology: each process is one replica serving its own HTTP
+port (``--serve-port + initial_rank``) over a replica-LOCAL device
+mesh (runtime.make_serve_mesh) — requests shard across replicas at the
+request level, so the predict program contains no cross-host
+collectives and a replica's dispatch cadence is its own.  The shared
+elastic world exists for membership only: a replica dying costs its
+in-flight requests (its clients see the connection drop), the
+survivors reconfigure at the next health tick and keep answering, and
+``--elastic-join`` grows the tier back.  Queued requests are host-side
+numpy arrays, so they SURVIVE a reconfigure: only the batch in flight
+when the world broke is at risk — and that batch lives on the rank
+that died.
+"""
+
+from .planner import parse_buckets, choose_bucket, plan_batch  # noqa: F401
+from .batcher import MicroBatcher, Request, QueueFullError  # noqa: F401
+from .server import ServingTier  # noqa: F401

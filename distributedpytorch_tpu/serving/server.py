@@ -1,0 +1,288 @@
+"""The serving replica: HTTP front end + micro-batch driver loop.
+
+Split of responsibilities (the thread model IS the design):
+
+  handler threads (ThreadingHTTPServer)  parse + validate the request,
+      ``admit()`` it into the bounded micro-batcher (503 on refusal —
+      the backpressure answer), then BLOCK on the request's event until
+      the driver answers.  Handlers never touch the device.
+  driver thread (``run()``, the caller's thread)  the only thread that
+      dispatches: coalesce pending requests into the largest ready
+      bucket (batcher.py), pad to the bucket size, call the injected
+      ``infer_fn``, fan results back out, and tick the elastic health
+      boundary between batches.  One dispatcher means no device-side
+      locking and a stable XLA dispatch cadence.
+
+``infer_fn`` is injected (a closure over the jitted predict program,
+built in cli.run_serve) so this module stays JAX-free: every queueing /
+deadline / shed / requeue behavior is unit-testable with a stub.
+
+Elastic contract: ``run()`` lets WorldChangedError (raised by the
+injected ``health_fn``) propagate AFTER the current batch resolved, so
+the caller can reconfigure the world, rebuild the predict program
+against the new generation, ``set_infer()`` it, and call ``run()``
+again — the HTTP listener and the queued requests (host-side numpy)
+persist across the reconfigure.  Only the dying rank's in-flight
+requests are lost, and they die with its sockets.
+
+Fault sites (faults.py): ``serve.request`` fires per request in the
+handler (an injected ioerror answers 500), ``serve.admit`` fires at
+admission (shed-path testing), ``serve.infer`` fires per micro-batch in
+the driver — ioerror fails that batch's requests and the loop carries
+on; rank_loss vanishes the replica mid-dispatch, the chaos-gate shape
+survivors must absorb.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import faults, telemetry
+from .batcher import MicroBatcher, Request
+
+# Driver poll granularity: the upper bound on how stale a shutdown /
+# health check can go while the queue is empty.
+_TICK_S = 0.25
+
+
+class ServingTier:
+    """One replica: owns the listener, the batcher, and the driver loop."""
+
+    def __init__(self, infer_fn: Callable[[np.ndarray], Tuple],
+                 sample_shape: Sequence[int], sample_dtype,
+                 buckets: Sequence[int], max_queue: int,
+                 max_latency_s: float, port: int,
+                 request_timeout_s: float = 30.0,
+                 max_requests: int = 0):
+        self._infer = infer_fn
+        self.sample_shape = tuple(int(d) for d in sample_shape)
+        self.sample_dtype = np.dtype(sample_dtype)
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.port = int(port)
+        self.request_timeout_s = float(request_timeout_s)
+        self.max_requests = int(max_requests)
+        self.batcher = MicroBatcher(self.buckets, max_queue, max_latency_s)
+        self.answered = 0        # driver thread only
+        self._stop = threading.Event()
+        self._server = None
+        self._http_thread = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        """Bind the port and start answering.  The listener outlives
+        elastic reconfigures — only close() takes it down."""
+        import http.server
+
+        tier = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 - http.server API
+                if self.path.rstrip("/") != "/predict":
+                    self.send_error(404)
+                    return
+                try:
+                    tier._handle_predict(self)
+                except BrokenPipeError:
+                    pass  # client gave up; its timeout, not our crash
+                except Exception as e:
+                    # A handler bug must answer THIS request and never
+                    # take the listener thread down with it.
+                    logging.error(f"serve: request handler failed: {e}")
+                    try:
+                        tier._respond(self, 500, {"error": repr(e)})
+                    # broad on purpose: the 500 above is best-effort —
+                    # if the socket is already gone there is nobody
+                    # left to answer, and raising would kill the
+                    # listener thread for everyone else
+                    except Exception:
+                        pass
+
+            def do_GET(self):  # noqa: N802 - http.server API
+                if self.path.rstrip("/") == "/livez":
+                    tier._respond(self, 200, tier.stats())
+                else:
+                    self.send_error(404)
+
+            def log_message(self, fmt, *args):
+                pass  # per-request lines would drown the run log
+
+        self._server = http.server.ThreadingHTTPServer(
+            ("0.0.0.0", self.port), _Handler)
+        self.port = self._server.server_address[1]  # resolve port=0
+        self._server.daemon_threads = True
+        self._http_thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.25},
+            name="serve-listener", daemon=True)
+        self._http_thread.start()
+        logging.info(
+            f"serve: listening on :{self.port} "
+            f"(buckets {list(self.buckets)}, queue bound "
+            f"{self.batcher.max_queue}, flush "
+            f"{self.batcher.max_latency_s * 1000:.0f}ms)")
+
+    def set_infer(self, infer_fn: Callable[[np.ndarray], Tuple]) -> None:
+        """Swap the predict program (post-reconfigure rebuild)."""
+        self._infer = infer_fn
+
+    def stop(self) -> None:
+        """Ask the driver loop to exit at the next boundary."""
+        self._stop.set()
+
+    def close(self) -> None:
+        """Stop the listener and answer every still-queued request with
+        a shutdown error — a draining tier never leaves a client
+        hanging on a request it silently dropped."""
+        self._stop.set()
+        for req in self.batcher.close():
+            req.fail(RuntimeError("server shutting down"))
+        server, self._server = self._server, None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+            self._http_thread.join(timeout=5.0)
+
+    # -- handler side (HTTP threads) ----------------------------------
+
+    def _respond(self, handler, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        handler.send_response(code)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def _handle_predict(self, handler) -> None:
+        tel = telemetry.get()
+        tel.counter("serve/requests").add()
+        try:
+            faults.fire("serve.request")
+            n = int(handler.headers.get("Content-Length", 0))
+            payload = json.loads(handler.rfile.read(n))
+            arr = np.asarray(payload["image"], dtype=self.sample_dtype)
+        except (KeyError, TypeError, ValueError) as e:
+            tel.counter("serve/bad_request").add()
+            self._respond(handler, 400, {"error": f"bad request: {e}"})
+            return
+        except OSError as e:  # injected serve.request ioerror included
+            tel.counter("serve/failed").add()
+            self._respond(handler, 500, {"error": repr(e)})
+            return
+        if arr.shape != self.sample_shape:
+            tel.counter("serve/bad_request").add()
+            self._respond(handler, 400, {
+                "error": f"image shape {list(arr.shape)} != expected "
+                         f"{list(self.sample_shape)}"})
+            return
+        req = Request(arr)
+        try:
+            faults.fire("serve.admit")
+            admitted = self.batcher.admit(req)
+        except OSError as e:
+            tel.counter("serve/failed").add()
+            self._respond(handler, 500, {"error": repr(e)})
+            return
+        if not admitted:
+            # THE backpressure answer: shed now, while the client can
+            # still retry elsewhere — a full queue must never grow.
+            tel.counter("serve/shed").add()
+            self._respond(handler, 503, {
+                "error": "queue full",
+                "queue_depth": self.batcher.depth()})
+            return
+        if not req.wait(self.request_timeout_s):
+            tel.counter("serve/timeout").add()
+            self._respond(handler, 504, {"error": "request timed out"})
+            return
+        if req.error is not None:
+            self._respond(handler, 503 if self._stop.is_set() else 500,
+                          {"error": repr(req.error)})
+            return
+        self._respond(handler, 200, req.result)
+
+    # -- driver side (run() caller's thread) --------------------------
+
+    def run(self, health_fn: Optional[Callable[[], bool]] = None,
+            health_tick_s: float = 0.5,
+            shutdown: Optional[Any] = None) -> int:
+        """The micro-batch loop.  Returns the number of requests
+        answered when stopped (stop()/close(), a shutdown request, a
+        health tick returning True, or --serve-max-requests reached).
+        WorldChangedError from ``health_fn`` propagates to the caller's
+        elastic loop with the queue intact."""
+        tel = telemetry.get()
+        next_health = time.monotonic() + health_tick_s
+        while not self._stop.is_set():
+            if shutdown is not None and getattr(shutdown, "requested",
+                                                False) \
+                    and health_fn is None:
+                break  # single-replica SIGTERM: no agreement needed
+            if self.max_requests and self.answered >= self.max_requests:
+                break
+            batch = self.batcher.next_batch(_TICK_S)
+            if batch is not None:
+                self._run_batch(tel, *batch)
+            if health_fn is not None \
+                    and time.monotonic() >= next_health:
+                # Between batches, never mid-dispatch: the boundary's
+                # collective must not interleave with a device step.
+                if health_fn():
+                    break
+                next_health = time.monotonic() + health_tick_s
+        return self.answered
+
+    def _run_batch(self, tel, reqs: List[Request], bucket: int) -> None:
+        arr = np.zeros((bucket,) + self.sample_shape, self.sample_dtype)
+        for i, r in enumerate(reqs):
+            arr[i] = r.payload
+        t0 = time.perf_counter()
+        try:
+            faults.fire("serve.infer")
+            labels, confs = self._infer(arr)
+        except Exception as e:
+            # One bad batch (an injected ioerror, a device hiccup) fails
+            # ITS requests and the tier keeps serving — dying here would
+            # turn a transient into an outage.
+            tel.counter("serve/failed").add(len(reqs))
+            tel.counter("serve/batches").add()
+            self.answered += len(reqs)
+            logging.error(f"serve: micro-batch of {len(reqs)} failed: {e}")
+            for r in reqs:
+                r.fail(e)
+            return
+        infer_ms = (time.perf_counter() - t0) * 1000.0
+        tel.counter("serve/batches").add()
+        tel.counter("serve/batch_rows").add(bucket)
+        tel.counter("serve/padded_rows").add(bucket - len(reqs))
+        tel.histogram("serve/infer_ms").observe(infer_ms)
+        tel.gauge("serve/queue_depth").set(self.batcher.depth())
+        for i, r in enumerate(reqs):
+            latency_ms = r.age_s() * 1000.0
+            tel.histogram("serve/request_latency_ms").observe(latency_ms)
+            r.complete({
+                "label": int(labels[i]),
+                "confidence": round(float(confs[i]), 6),
+                "bucket": bucket,
+                "latency_ms": round(latency_ms, 3),
+            })
+        tel.counter("serve/answered").add(len(reqs))
+        self.answered += len(reqs)
+
+    # -- introspection -------------------------------------------------
+
+    def stats(self) -> dict:
+        """/livez body + the exporter's extra-health payload."""
+        return {
+            "ok": True,
+            "queue_depth": self.batcher.depth(),
+            "answered": self.answered,
+            "buckets": list(self.buckets),
+            "port": self.port,
+        }

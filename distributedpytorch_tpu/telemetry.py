@@ -240,7 +240,12 @@ class Telemetry:
         self._histograms: Dict[str, Histogram] = {}
         self._local = threading.local()
         self._buffer: List[str] = []
-        self._lock = threading.Lock()
+        # REENTRANT on purpose: the preempt signal handler
+        # (utils.GracefulShutdown) calls event() on the main thread and
+        # may interrupt a frame that already holds this lock — a plain
+        # Lock self-deadlocks there, hanging the run the handler exists
+        # to stop cleanly.
+        self._lock = threading.RLock()
         self._file = None
         self.write_errors = 0
         self._sink_dead = False
@@ -674,6 +679,30 @@ def render_report(agg: Dict[str, Any]) -> str:
                 f"async checkpointing: {blocking['total_s']:.3f}s of "
                 f"{total:.3f}s save time on the critical path "
                 f"({blocking['total_s'] / total * 100:.1f}%)")
+
+    # Serving saturation (ISSUE 15): the tier's one-look health — how
+    # much load arrived, how much was shed at the bounded queue (the
+    # saturation fraction), and how well the micro-batcher filled its
+    # buckets (padding is paid compute).  The latency percentiles are
+    # already in the histogram table above (serve/request_latency_ms).
+    requests = agg["counters"].get("serve/requests")
+    if requests:
+        shed = agg["counters"].get("serve/shed", 0.0)
+        answered = agg["counters"].get("serve/answered", 0.0)
+        failed = agg["counters"].get("serve/failed", 0.0)
+        lines.append("")
+        lines.append(f"serving: {int(requests)} requests — "
+                     f"{int(answered)} answered, {int(failed)} failed, "
+                     f"{int(shed)} shed at the full queue "
+                     f"(saturation {shed / requests * 100:.1f}%)")
+        sbatches = agg["counters"].get("serve/batches")
+        rows = agg["counters"].get("serve/batch_rows", 0.0)
+        padded = agg["counters"].get("serve/padded_rows", 0.0)
+        if sbatches and rows:
+            lines.append(
+                f"  micro-batches: {int(sbatches)} dispatched, mean "
+                f"fill {(rows - padded) / sbatches:.1f} rows, padding "
+                f"overhead {padded / rows * 100:.1f}% of batch rows")
 
     preempts = [e for e in agg["events"] if e.get("name") == "preempt"]
     if preempts:

@@ -138,6 +138,9 @@ class Engine:
         donate = (0,) if runtime.donation_safe() else ()
         self.train_step = jax.jit(self._train_step, donate_argnums=donate)
         self.eval_step = jax.jit(self._eval_step)
+        # The serving tier's program (cli.run_serve): AOT-lowered per
+        # batch-size bucket so request-path shapes never compile.
+        self.predict_step = jax.jit(self._predict_step)
         # Two-dispatch diagnostic variant of train_step: backward and
         # optimizer as SEPARATE compiled programs.  scripts/precision_gate.py
         # pins fused == unfused bit-identically in f32 — the proof that
@@ -623,3 +626,23 @@ class Engine:
             "correct": jnp.sum(correct),
             "valid": jnp.sum(vmask),
         }
+
+    def _predict_step(self, state: TrainState, images_u8
+                      ) -> Tuple[jax.Array, jax.Array]:
+        """Serving-side inference: (labels, confidences) per row.
+
+        Eval-mode apply (BatchNorm running stats, no dropout) makes
+        every output row a function of its own input row only — which
+        is what lets the micro-batcher pad short batches with zero rows
+        and discard the padded outputs (pinned by tests/test_serve.py).
+        Softmax runs in accum_dtype so the confidence is honest even
+        under bf16 compute."""
+        imgs = augment.eval_transform(images_u8, self.mean, self.std,
+                                      self.input_size,
+                                      out_dtype=self.compute_dtype)
+        out, _, _ = self._apply(state.params, state.batch_stats, imgs,
+                                False, None)
+        logits = out[0] if isinstance(out, tuple) else out
+        probs = jax.nn.softmax(logits.astype(self.accum_dtype), axis=-1)
+        return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                jnp.max(probs, axis=-1))

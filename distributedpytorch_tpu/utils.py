@@ -83,16 +83,25 @@ class GracefulShutdown:
         self.requested = True
         # Telemetry point event, buffered (no file I/O in the handler);
         # the epoch-boundary flush or close() writes it out, so even a
-        # preempted run's JSONL records when the signal landed.
+        # preempted run's JSONL records when the signal landed.  Both
+        # sinks take REENTRANT locks (the handler runs on the main
+        # thread and may have interrupted a frame inside them).
         from . import flightrec, telemetry
 
-        telemetry.get().event("preempt_signal", signum=int(signum))
-        # The flight recorder DOES dump here (one bounded JSON write):
-        # the grace window may be cut short by the platform, and the
-        # black box is only worth carrying if it survives the preempt.
-        rec = flightrec.get()
-        rec.record_event("preempt_signal", signum=int(signum))
-        rec.dump("preempt_signal")
+        try:
+            telemetry.get().event("preempt_signal", signum=int(signum))
+            # The flight recorder DOES dump here (one bounded JSON
+            # write): the grace window may be cut short by the
+            # platform, and the black box is only worth carrying if it
+            # survives the preempt.
+            rec = flightrec.get()
+            rec.record_event("preempt_signal", signum=int(signum))
+            rec.dump("preempt_signal")
+        # broad on purpose: an exception escaping a signal handler is
+        # raised INTO the interrupted frame — a failed audit write must
+        # never crash the epoch the graceful path is trying to finish
+        except Exception:
+            logging.exception("preempt handler: audit write failed")
         logging.warning(
             f"received signal {signum}: finishing the current epoch, "
             "then checkpointing and exiting (repeat to abort immediately)")

@@ -39,11 +39,21 @@ Four stages, all CPU and bounded:
      equal (allclose) an uninterrupted 3-rank reference run resumed
      from a copy of that same snapshot — proving restore-into-a-
      larger-mesh and the N+1 loader re-derivation end to end.
+  G. serve (``--stage serve``, its own gate.sh leg) — the serving
+     tier's failure paths (ISSUE 15), end to end over real HTTP: two
+     ``main.py serve`` replicas in a 2-rank elastic gloo world.  An
+     injected ``serve.infer`` ioerror on replica 0 must fail exactly
+     ONE request's micro-batch (a 500 answer) and leave the tier
+     serving; a ``serve.infer`` rank_loss on replica 1 vanishes it
+     mid-batch — only that in-flight request dies with its socket.
+     Replica 0 must then reconfigure (``elastic/reconfigure`` with
+     ``purpose: "serve"`` and a 1-world) and KEEP ANSWERING on the
+     same port, and SIGTERM must drain it to exit 0.
 
 Run as ``env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py``
-(stages A-D) or with ``--stage elastic`` / ``--stage grow`` (one stage
-each).  The script re-execs itself with ``--child`` for the
-multi-process stages' ranks.
+(stages A-D) or with ``--stage elastic`` / ``--stage grow`` /
+``--stage serve`` (one stage each).  The script re-execs itself with
+``--child`` for the multi-process stages' ranks.
 """
 
 import argparse
@@ -138,6 +148,17 @@ def main(stage: str = "core") -> int:
         print("chaos gate OK: world shrank on rank loss, grew back on "
               "the rejoin, and the grown world matches the "
               "uninterrupted 3-rank reference")
+        return 0
+
+    if stage == "serve":
+        problems = _stage_serve(work)
+        for p in problems:
+            print(f"PROBLEM: {p}", file=sys.stderr)
+        if problems:
+            return 1
+        print("chaos gate OK: serve replica survived the injected "
+              "batch fault, the survivor reconfigured past the rank "
+              "loss and kept answering, SIGTERM drained clean")
         return 0
 
     # -- stage A: fault-free reference --------------------------------
@@ -653,6 +674,206 @@ def _stage_grow(work: str) -> list:
     return problems
 
 
+SERVE_LIVE_WAIT_S = 240.0
+
+
+def _serve_post(port: int, timeout: float = 35.0):
+    """One /predict round trip -> (status, body) — HTTPError unwrapped,
+    transport-level death (the rank_loss shape) re-raised."""
+    import urllib.error
+    import urllib.request
+
+    sample = [[(r * 28 + c) % 256 for c in range(28)] for r in range(28)]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps({"image": sample}).encode())
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _serve_wait_live(port: int, proc, timeout_s: float) -> bool:
+    import urllib.request
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return False
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/livez", timeout=5) as r:
+                if json.loads(r.read()).get("ok"):
+                    return True
+        except (OSError, ValueError):
+            time.sleep(0.5)
+    return False
+
+
+def _stage_serve(work: str) -> list:
+    """Stage G driver: train a checkpoint, stand up a 2-rank elastic
+    serve world (one replica per rank, port = base + rank), and walk
+    the failure ladder over real HTTP: one injected batch ioerror on
+    replica 0 (a 500, tier keeps serving), a rank_loss mid-batch on
+    replica 1 (its in-flight request dies with the socket), then the
+    survivor's reconfigure — it must keep answering on the same port
+    and drain clean on SIGTERM."""
+    import signal
+    import socket
+
+    from distributedpytorch_tpu.cli import run_train
+    from distributedpytorch_tpu.faults import RANK_LOSS_EXIT
+
+    problems = []
+    # The checkpoint the replicas load, with its lineage ledger: a
+    # 1-epoch in-process training run in the SHARED serve dir.
+    rsl = os.path.join(work, "serve")
+    os.makedirs(rsl, exist_ok=True)
+    run_train(_base_cfg(rsl).replace(nb_epochs=1))
+    ckpt_file = os.path.join(rsl, "bestmodel-synthetic-mlp.ckpt")
+    if not os.path.exists(ckpt_file):
+        return [f"provenance training run left no checkpoint at "
+                f"{ckpt_file}"]
+
+    # Replica 0: batch 2's infer raises (one 500, tier survives).
+    # Replica 1: batch 3's infer is a rank loss (os._exit mid-dispatch).
+    plan_path = os.path.join(work, "serve_plan.json")
+    with open(plan_path, "w") as f:
+        json.dump({"faults": [
+            {"site": "serve.infer", "kind": "ioerror", "after_n": 1,
+             "count": 1, "rank": 0},
+            {"site": "serve.infer", "kind": "rank_loss", "after_n": 2,
+             "count": 1, "rank": 1},
+        ]}, f)
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        coord = f"localhost:{s.getsockname()[1]}"
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        base_port = s.getsockname()[1]
+
+    env = _child_env()
+    procs = []
+    for pid in range(2):
+        log = os.path.join(work, f"serve_rank{pid}.log")
+        out = open(log, "ab")
+        procs.append((pid, subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             "--serve", "--coord", coord, "--pid", str(pid),
+             "--nprocs", "2", "--rsl", rsl, "--ckpt", ckpt_file,
+             "--plan", plan_path, "--serve-port", str(base_port),
+             "--elastic"],
+            cwd=REPO, env=env, stdout=out, stderr=out), log))
+    ports = {pid: base_port + pid for pid, _, _ in procs}
+
+    try:
+        for pid, p, log in procs:
+            if not _serve_wait_live(ports[pid], p, SERVE_LIVE_WAIT_S):
+                return [f"serve replica {pid} never went live on "
+                        f":{ports[pid]}\n{_tail(log)}"]
+        print("chaos gate G: both replicas live, walking the ladder")
+
+        # Rung 1 — replica 0: 200, injected-500, 200.  One bad batch
+        # fails ITS request and nothing else.
+        seq = [_serve_post(ports[0]) for _ in range(3)]
+        codes = [s for s, _ in seq]
+        if codes != [200, 500, 200]:
+            problems.append(f"replica 0 answered {codes} around the "
+                            f"injected serve.infer ioerror, expected "
+                            f"[200, 500, 200]")
+        elif "injected" not in seq[1][1].get("error", ""):
+            problems.append(f"replica 0's 500 does not carry the "
+                            f"injected error: {seq[1][1]}")
+
+        # Rung 2 — replica 1: two clean answers, then the rank loss
+        # takes the replica AND the in-flight request's socket.
+        for i in range(2):
+            s, b = _serve_post(ports[1])
+            if s != 200:
+                problems.append(f"replica 1 request {i} answered {s} "
+                                f"({b}) before any fault")
+        try:
+            s, b = _serve_post(ports[1], timeout=20.0)
+            problems.append(f"replica 1's rank-loss request ANSWERED "
+                            f"({s}, {b}) — the fault did not fire")
+        except OSError:
+            pass  # the expected shape: connection died mid-request
+        rc1 = procs[1][1].wait(timeout=60)
+        if rc1 != RANK_LOSS_EXIT:
+            problems.append(f"replica 1 exited rc={rc1}, expected the "
+                            f"rank-loss status {RANK_LOSS_EXIT}"
+                            f"\n{_tail(procs[1][2])}")
+
+        # Rung 3 — the survivor reconfigures (purpose tagged "serve",
+        # world of 1) and keeps answering on its ORIGINAL port.
+        rec = _wait_for_event(
+            rsl, 0, "elastic/reconfigure",
+            lambda e: e.get("attrs", {}).get("purpose") == "serve",
+            timeout_s=180.0)
+        if rec is None:
+            problems.append(f"survivor replica 0 never logged a "
+                            f"purpose=serve elastic/reconfigure"
+                            f"\n{_tail(procs[0][2])}")
+        elif rec["attrs"].get("new_world") != 1:
+            problems.append(f"survivor reconfigured to world "
+                            f"{rec['attrs'].get('new_world')}, not 1")
+        answered_after = None
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if procs[0][1].poll() is not None:
+                break
+            try:
+                answered_after = _serve_post(ports[0], timeout=20.0)
+                if answered_after[0] == 200:
+                    break
+            except OSError:
+                time.sleep(1.0)
+        if not answered_after or answered_after[0] != 200:
+            problems.append(f"survivor stopped answering after the "
+                            f"reconfigure (last: {answered_after})"
+                            f"\n{_tail(procs[0][2])}")
+
+        # Rung 4 — drain: SIGTERM must exit 0 through the health tick.
+        procs[0][1].send_signal(signal.SIGTERM)
+        try:
+            rc0 = procs[0][1].wait(timeout=90)
+        except subprocess.TimeoutExpired:
+            procs[0][1].kill()
+            rc0 = None
+        if rc0 != 0:
+            problems.append(f"survivor exited rc={rc0} on SIGTERM, "
+                            f"expected a clean 0\n{_tail(procs[0][2])}")
+    finally:
+        for _, p, _ in procs:
+            if p.poll() is None:
+                p.kill()
+
+    # The audit trail: the injected faults in each replica's JSONL.
+    try:
+        fired0 = _named(_events(rsl, rank=0), "fault_injected")
+    except OSError:
+        fired0 = []
+    if not any(e["attrs"].get("site") == "serve.infer"
+               and e["attrs"].get("kind") == "ioerror" for e in fired0):
+        problems.append("replica 0 JSONL lacks the serve.infer ioerror "
+                        "fault_injected event")
+    try:
+        fired1 = _named(_events(rsl, rank=1), "fault_injected")
+    except OSError:
+        fired1 = []
+    if not any(e["attrs"].get("site") == "serve.infer"
+               and e["attrs"].get("kind") == "rank_loss"
+               for e in fired1):
+        problems.append("replica 1 JSONL lacks the serve.infer "
+                        "rank_loss fault_injected event")
+    if not problems:
+        print("chaos gate G: 500-and-carry-on on replica 0, rank loss "
+              "absorbed, survivor reconfigured and kept answering")
+    return problems
+
+
 def _tail(path: str, n: int = 2500) -> str:
     try:
         return open(path).read()[-n:]
@@ -673,7 +894,7 @@ def child_main(a) -> int:
     jax.config.update("jax_cpu_enable_async_dispatch", False)
 
     from distributedpytorch_tpu import elastic, faults, runtime
-    from distributedpytorch_tpu.cli import run_train
+    from distributedpytorch_tpu.cli import run_serve, run_train
 
     if not a.join:
         # A joiner never dials the old coordinator: run_train routes it
@@ -682,6 +903,26 @@ def child_main(a) -> int:
                                        num_processes=a.nprocs,
                                        process_id=a.pid,
                                        elastic=a.elastic)
+    if a.serve:
+        # Stage G rank: one serving replica (run_serve finds the
+        # runtime already initialized).  rc mirrors the train path.
+        cfg = _base_cfg(a.rsl).replace(
+            action="serve", checkpoint_file=a.ckpt, fault_plan=a.plan,
+            elastic=a.elastic, serve_port=a.serve_port,
+            serve_buckets="1,4", serve_max_latency_ms=10.0,
+            serve_queue=16, health_timeout=20.0)
+        try:
+            run_serve(cfg)
+        except (faults.FatalFaultError, faults.PeerFailureError) as e:
+            print(f"rank {a.pid}: agreed fatal exit: {e}",
+                  file=sys.stderr)
+            rc = CHILD_EXIT
+        else:
+            rc = 0
+            print(f"rank {a.pid}: serve drained, rc=0", file=sys.stderr)
+        if elastic.reconfigured():
+            elastic.quiesce_exit(rc)  # never returns
+        return rc
     cfg = _base_cfg(a.rsl).replace(
         fault_plan=a.plan, nb_epochs=a.epochs, batch_size=4,
         checkpoint_file=a.ckpt, elastic=a.elastic or a.join,
@@ -709,10 +950,14 @@ def child_main(a) -> int:
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
-    ap.add_argument("--stage", choices=("core", "elastic", "grow"),
+    ap.add_argument("--stage", choices=("core", "elastic", "grow",
+                                        "serve"),
                     default="core")
     ap.add_argument("--child", action="store_true")
     ap.add_argument("--join", action="store_true")
+    ap.add_argument("--serve", action="store_true")
+    ap.add_argument("--serve-port", type=int, default=0,
+                    dest="serve_port")
     ap.add_argument("--coord")
     ap.add_argument("--pid", type=int)
     ap.add_argument("--nprocs", type=int, default=2)

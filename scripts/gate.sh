@@ -59,7 +59,17 @@
 #      its own regression ledger — deltas only between fresh rows,
 #      latest fresh-vs-fresh delta within threshold — see
 #      scripts/bench_trend.py
-#  12. the driver's own gate: __graft_entry__.dryrun_multichip(8)
+#  12. serve gate: a 2-bucket ``main.py serve`` replica under a real
+#      localhost load generator — client p95 + throughput floors, live
+#      dpt_serve_* /metrics scraped mid-load, saturation answered with
+#      counted 503 sheds (never hung clients), SIGTERM drain — see
+#      scripts/serve_gate.py and README "Serving"
+#  13. serve-chaos gate: two serve replicas in a 2-rank elastic gloo
+#      world; an injected batch ioerror answers 500 and the tier keeps
+#      serving, a rank_loss vanishes replica 1 mid-batch, the survivor
+#      reconfigures (purpose=serve) and keeps answering on its port —
+#      see scripts/chaos_gate.py --stage serve and README "Serving"
+#  14. the driver's own gate: __graft_entry__.dryrun_multichip(8)
 #      (clean env, exactly as the driver runs it)
 #
 # Tier map:
@@ -124,6 +134,12 @@ env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/roofline_gate.py
 
 echo "== gate: bench trend (regression ledger on checked-in history) =="
 python scripts/bench_trend.py
+
+echo "== gate: serve (latency floors / live metrics / shed) =="
+env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/serve_gate.py
+
+echo "== gate: serve-chaos (batch fault / rank loss / survivor) =="
+env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py --stage serve
 
 echo "== gate: dryrun_multichip(8) =="
 env -u XLA_FLAGS -u JAX_PLATFORMS python -c \

@@ -1,0 +1,309 @@
+#!/usr/bin/env python3
+"""Serving-tier gate leg (scripts/gate.sh), on CPU.
+
+Proves ``main.py serve`` against a real checkpoint, with a real load
+generator over localhost HTTP.  Three stages, all bounded:
+
+  0. provenance — a 1-epoch synthetic mlp training run writes the
+     checkpoint the server will load (same RSL dir, so the server's
+     AOT bucket warmup replays the persistent XLA cache).
+  A. latency + throughput floors, scraped live — a 2-bucket server
+     (``--serve-buckets 1,8``) under 8 concurrent closed-loop clients.
+     Pins client-side p95 latency and aggregate throughput floors (a
+     serialize-everything or flush-deadline regression fails here, with
+     head-room for this single-core CPU host), and scrapes the live
+     exporter MID-LOAD: /metrics must carry the ``dpt_serve_*`` series
+     (requests counter, latency summary quantiles), /healthz the
+     tier's queue-depth extra.  SIGTERM must then drain to exit 0.
+  B. saturation + shed — the same server with ``--serve-queue 8`` and
+     an injected 0.25 s ``serve.infer`` stall (every micro-batch goes
+     slow, so arrival far outruns service).  A 48-request burst must
+     split into answered 200s and IMMEDIATE 503 sheds — counted, never
+     hung, queue depth never past the bound — and the shed counter
+     must land in /metrics.
+
+Run as ``env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/serve_gate.py``.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Stage A floors: deliberately loose for a single-core CPU host sharing
+# client threads with the server — they pin pathologies (per-request
+# compiles, a broken flush deadline, serialized handlers), not peak
+# performance.
+P95_MAX_MS = 2000.0
+THROUGHPUT_MIN_RPS = 10.0
+LOAD_CLIENTS = 8
+LOAD_REQS_EACH = 8          # 64 requests total
+BURST = 48                  # stage B concurrent one-shot clients
+STALL_PLAN = "serve.infer:stall:0:1000000:0.25"
+
+SAMPLE = [[(r * 28 + c) % 256 for c in range(28)] for r in range(28)]
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _env() -> dict:
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _post(port: int, timeout: float = 35.0):
+    """One /predict round trip -> (status, body dict, client seconds)."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps({"image": SAMPLE}).encode())
+    t0 = time.perf_counter()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), time.perf_counter() - t0
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), time.perf_counter() - t0
+
+
+def _scrape(port: int, path: str):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.read().decode("utf-8")
+
+
+def _wait_live(port: int, proc, timeout_s: float = 120.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"server exited rc={proc.returncode} before serving")
+        try:
+            if json.loads(_scrape(port, "/livez")).get("ok"):
+                return
+        except (OSError, ValueError):
+            time.sleep(0.2)
+    raise RuntimeError(f"server not live on :{port} within {timeout_s}s")
+
+
+def _launch_server(rsl: str, ckpt: str, port: int, metrics_port: int,
+                   queue: int, extra=(), tag: str = "serve"):
+    cmd = [sys.executable, "main.py", "serve", "-d", "/nodata",
+           "--dataset", "synthetic", "--model", "mlp", "-f", ckpt,
+           "--rsl_path", rsl, "--serve-port", str(port),
+           "--serve-buckets", "1,8", "--serve-max-latency-ms", "5",
+           "--serve-queue", str(queue),
+           "--metrics-port", str(metrics_port), *extra]
+    log = open(os.path.join(rsl, f"{tag}.log"), "w")
+    proc = subprocess.Popen(cmd, cwd=REPO, env=_env(), stdout=log,
+                            stderr=subprocess.STDOUT)
+    return proc, log
+
+
+def _stop_server(proc, log, problems, tag: str) -> None:
+    proc.send_signal(signal.SIGTERM)
+    try:
+        rc = proc.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        problems.append(f"{tag}: server hung on SIGTERM — drain broke")
+        rc = None
+    if rc not in (0, None):
+        problems.append(f"{tag}: SIGTERM drain exited rc={rc}, "
+                        f"expected 0 (see {log.name})")
+    log.close()
+
+
+def main() -> int:
+    problems = []
+    work = tempfile.mkdtemp(prefix="serve_gate_")
+    rsl = os.path.join(work, "rsl")
+
+    # -- stage 0: train the checkpoint the server will load -----------
+    t0 = time.perf_counter()
+    train = subprocess.run(
+        [sys.executable, "main.py", "train", "-d", "/nodata",
+         "--dataset", "synthetic", "--model", "mlp", "-b", "8",
+         "-e", "1", "--rsl_path", rsl],
+        cwd=REPO, env=_env(), capture_output=True, text=True)
+    if train.returncode != 0:
+        print(f"PROBLEM: checkpoint-provenance training run failed "
+              f"rc={train.returncode}:\n{train.stdout[-800:]}\n"
+              f"{train.stderr[-800:]}", file=sys.stderr)
+        return 1
+    ckpt = os.path.join(rsl, "bestmodel-synthetic-mlp.ckpt")
+    print(f"serve gate 0: checkpoint trained in "
+          f"{time.perf_counter() - t0:.1f}s")
+
+    # -- stage A: floors + live scrape under concurrent load ----------
+    port, mport = _free_port(), _free_port()
+    proc, log = _launch_server(rsl, ckpt, port, mport, queue=64,
+                               tag="serve_a")
+    try:
+        _wait_live(port, proc)
+        status, body, _ = _post(port)   # functional round trip first
+        if status != 200 or not (0.0 < body.get("confidence", 0) <= 1.0):
+            problems.append(f"A: warm request failed: {status} {body}")
+
+        results, mid_metrics, mid_health = [], [None], [None]
+        lock = threading.Lock()
+
+        def client():
+            for _ in range(LOAD_REQS_EACH):
+                out = _post(port)
+                with lock:
+                    results.append(out)
+
+        def scraper():
+            # mid-load by construction: fires while clients are running
+            time.sleep(0.3)
+            try:
+                mid_metrics[0] = _scrape(mport, "/metrics")
+                mid_health[0] = json.loads(_scrape(mport, "/healthz"))
+            except (OSError, ValueError) as e:
+                problems.append(f"A: mid-load scrape failed: {e}")
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(LOAD_CLIENTS)]
+        threads.append(threading.Thread(target=scraper))
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        elapsed = time.perf_counter() - t0
+
+        total = LOAD_CLIENTS * LOAD_REQS_EACH
+        if len(results) != total:
+            problems.append(f"A: {total - len(results)} of {total} "
+                            f"requests never returned — hung clients")
+        bad = [(s, b) for s, b, _ in results if s != 200]
+        if bad:
+            problems.append(f"A: {len(bad)} non-200 answers under "
+                            f"in-bounds load, first: {bad[0]}")
+        if results:
+            lats = sorted(dt * 1000.0 for _, _, dt in results)
+            p50 = lats[len(lats) // 2]
+            p95 = lats[min(len(lats) - 1, int(len(lats) * 0.95))]
+            rps = len(results) / elapsed
+            if p95 > P95_MAX_MS:
+                problems.append(f"A: client p95 {p95:.0f}ms over the "
+                                f"{P95_MAX_MS:.0f}ms floor")
+            if rps < THROUGHPUT_MIN_RPS:
+                problems.append(f"A: throughput {rps:.1f} req/s under "
+                                f"the {THROUGHPUT_MIN_RPS} req/s floor")
+            print(f"serve gate A: {len(results)} reqs in {elapsed:.2f}s "
+                  f"({rps:.0f} req/s), p50 {p50:.0f}ms p95 {p95:.0f}ms")
+
+        body = mid_metrics[0] or ""
+        for needle in ("dpt_serve_requests_total",
+                       'dpt_serve_request_latency_ms{quantile="0.95"}',
+                       "dpt_serve_batches_total", "dpt_up 1"):
+            if needle not in body:
+                problems.append(f"A: mid-load /metrics missing "
+                                f"{needle!r}")
+        health = mid_health[0] or {}
+        if "serve" not in health or "queue_depth" not in \
+                health.get("serve", {}):
+            problems.append(f"A: /healthz missing the serve extra "
+                            f"(queue depth): {health}")
+    finally:
+        _stop_server(proc, log, problems, "A")
+
+    # -- stage B: saturation — shed counted, never hung ---------------
+    port, mport = _free_port(), _free_port()
+    proc, log = _launch_server(
+        rsl, ckpt, port, mport, queue=8,
+        extra=("--fault-plan", STALL_PLAN), tag="serve_b")
+    try:
+        _wait_live(port, proc)
+        results = []
+        lock = threading.Lock()
+
+        def one_shot():
+            out = _post(port)
+            with lock:
+                results.append(out)
+
+        threads = [threading.Thread(target=one_shot)
+                   for _ in range(BURST)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        elapsed = time.perf_counter() - t0
+
+        if len(results) != BURST:
+            problems.append(f"B: {BURST - len(results)} of {BURST} "
+                            f"burst requests never returned — a full "
+                            f"queue HUNG clients instead of shedding")
+        answered = [r for r in results if r[0] == 200]
+        shed = [r for r in results if r[0] == 503]
+        other = [r for r in results if r[0] not in (200, 503)]
+        if other:
+            problems.append(f"B: unexpected status under saturation, "
+                            f"first: {other[0][:2]}")
+        if not shed:
+            problems.append(f"B: no 503 sheds out of {BURST} burst "
+                            f"requests against a queue of 8 with a "
+                            f"0.25s/batch stall — backpressure is not "
+                            f"answering")
+        if not answered:
+            problems.append("B: nothing answered under saturation — "
+                            "shedding everything is an outage, not "
+                            "backpressure")
+        for _, b, _ in shed:
+            if b.get("queue_depth", 0) > 8:
+                problems.append(f"B: shed response reports queue depth "
+                                f"{b['queue_depth']} past the bound 8 "
+                                f"— the queue grew")
+                break
+        # shed answers must be immediate, not timed out: the slowest
+        # shed stays far under the 0.25s/batch service time backlog
+        slow_shed = [dt for s, _, dt in results if s == 503 and dt > 5.0]
+        if slow_shed:
+            problems.append(f"B: {len(slow_shed)} shed answer(s) took "
+                            f">5s — 503s must be immediate")
+        try:
+            metrics = _scrape(mport, "/metrics")
+            if "dpt_serve_shed_total" not in metrics:
+                problems.append("B: dpt_serve_shed_total missing from "
+                                "/metrics after sheds")
+        except OSError as e:
+            problems.append(f"B: post-burst /metrics scrape failed: {e}")
+        print(f"serve gate B: burst {BURST} -> {len(answered)} "
+              f"answered, {len(shed)} shed in {elapsed:.2f}s")
+    finally:
+        _stop_server(proc, log, problems, "B")
+
+    for p in problems:
+        print(f"PROBLEM: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print("serve gate OK: floors held under load, live dpt_serve_* "
+          "metrics scraped mid-run, saturation shed with 503s (counted, "
+          "never hung), SIGTERM drained clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -966,6 +966,128 @@ def test_h2d_non_step_module_negative(tmp_path):
                  rule="blocking-h2d-in-step-loop") == []
 
 
+# -- rule 15: unbounded-queue-in-server --------------------------------
+
+_SERVER_QUEUE_BAD = """
+    import queue
+
+    class Handler:
+        def __init__(self):
+            self.requests = queue.Queue()
+
+        def handle(self, req):
+            self.requests.put(req)
+"""
+
+_SERVER_QUEUE_GOOD = """
+    import queue
+
+    class Handler:
+        def __init__(self, max_queue):
+            self.requests = queue.Queue(maxsize=max_queue)
+
+        def handle(self, req):
+            try:
+                self.requests.put_nowait(req)
+            except queue.Full:
+                return 503
+            return 200
+"""
+
+_SERVER_LOOP_BAD = """
+    class Server:
+        def __init__(self):
+            self.pending = []
+
+        def accept_loop(self, sock):
+            while True:
+                req = sock.recv()
+                self.pending.append(req)
+"""
+
+_SERVER_LOOP_GOOD = """
+    class Server:
+        def __init__(self, max_queue):
+            self.pending = []
+            self.max_queue = max_queue
+
+        def accept_loop(self, sock):
+            while True:
+                req = sock.recv()
+                if len(self.pending) >= self.max_queue:
+                    req.answer(503)      # shed with an answer
+                    continue
+                self.pending.append(req)
+"""
+
+
+def test_unbounded_queue_ctor_positive(tmp_path):
+    found = _lint(tmp_path, {"server.py": _SERVER_QUEUE_BAD},
+                  rule="unbounded-queue-in-server")
+    assert len(found) == 1
+    assert "maxsize" in found[0].message
+
+
+def test_bounded_queue_ctor_negative(tmp_path):
+    assert _lint(tmp_path, {"server.py": _SERVER_QUEUE_GOOD},
+                 rule="unbounded-queue-in-server") == []
+
+
+def test_queue_maxsize_zero_is_unbounded_positive(tmp_path):
+    src = """
+        import queue
+
+        class Handler:
+            def __init__(self):
+                self.requests = queue.Queue(maxsize=0)
+    """
+    found = _lint(tmp_path, {"handler.py": src},
+                  rule="unbounded-queue-in-server")
+    assert len(found) == 1
+
+
+def test_producer_loop_append_positive(tmp_path):
+    found = _lint(tmp_path, {"server.py": _SERVER_LOOP_BAD},
+                  rule="unbounded-queue-in-server")
+    assert len(found) == 1
+    assert "while True" in found[0].message
+
+
+def test_producer_loop_with_shed_guard_negative(tmp_path):
+    assert _lint(tmp_path, {"server.py": _SERVER_LOOP_GOOD},
+                 rule="unbounded-queue-in-server") == []
+
+
+def test_unbounded_queue_rationale_comment_silences(tmp_path):
+    src = """
+        import queue
+
+        class Handler:
+            def __init__(self):
+                # bounded by the admit() check in accept(): overflow is
+                # answered with 503 before anything reaches this queue
+                self.requests = queue.Queue()
+    """
+    assert _lint(tmp_path, {"server.py": src},
+                 rule="unbounded-queue-in-server") == []
+
+
+def test_unbounded_queue_non_server_module_negative(tmp_path):
+    # only serving/request-handler modules are in scope: a pipeline's
+    # internal queue has its own bounding story (rule 6 territory)
+    assert _lint(tmp_path, {"pipeline.py": _SERVER_QUEUE_BAD},
+                 rule="unbounded-queue-in-server") == []
+
+
+def test_serving_package_path_is_in_scope(tmp_path):
+    pkg = tmp_path / "serving"
+    pkg.mkdir()
+    (pkg / "dispatch.py").write_text(textwrap.dedent(_SERVER_QUEUE_BAD))
+    findings, _ = lint_paths([str(tmp_path)], root=str(tmp_path))
+    assert [f for f in findings
+            if f.rule == "unbounded-queue-in-server"]
+
+
 # -- CLI contract ------------------------------------------------------
 
 def test_repo_lints_clean_via_run_cli(capsys):

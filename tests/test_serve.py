@@ -1,0 +1,455 @@
+"""The serving tier (distributedpytorch_tpu/serving, ISSUE 15).
+
+Fast layers first: the bucket planner and micro-batcher are pure
+stdlib+numpy (no JAX) and are tested as units — coalescing, the flush
+deadline, explicit backpressure, requeue order, close-drains.  The
+ServingTier HTTP round trip runs in-process against a stub infer_fn on
+an ephemeral port.  The JAX-backed contracts — padded rows provably
+inert in predict_step, cross-layout restore_for_serving — use the
+cheap zoo models on the synthetic dataset.  The full `main.py serve`
+CLI path (AOT-warmed buckets answering real requests, /metrics live,
+rank loss mid-serve) is the serve_gate's and chaos stage G's job.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.serving import (MicroBatcher, Request,
+                                            ServingTier, choose_bucket,
+                                            parse_buckets, plan_batch)
+
+# -- bucket planner ----------------------------------------------------
+
+
+def test_parse_buckets_string_and_sequence():
+    assert parse_buckets("1,4,16,64") == (1, 4, 16, 64)
+    assert parse_buckets("16, 4,1") == (1, 4, 16)
+    assert parse_buckets([8, 2, 8]) == (2, 8)
+
+
+def test_parse_buckets_rejects_garbage():
+    with pytest.raises(ValueError, match="comma-separated"):
+        parse_buckets("1,two")
+    with pytest.raises(ValueError, match="at least one"):
+        parse_buckets("")
+    with pytest.raises(ValueError, match=">= 1"):
+        parse_buckets("4,0")
+
+
+def test_choose_bucket_largest_filled_else_smallest():
+    buckets = (1, 4, 16)
+    assert choose_bucket(1, buckets) == 1
+    assert choose_bucket(3, buckets) == 1
+    assert choose_bucket(4, buckets) == 4
+    assert choose_bucket(15, buckets) == 4
+    assert choose_bucket(16, buckets) == 16
+    assert choose_bucket(100, buckets) == 16
+    # pending below every bucket pads up to the smallest
+    assert choose_bucket(1, (4, 16)) == 4
+
+
+def test_plan_batch_take_and_padding():
+    assert plan_batch(3, (1, 4, 16)) == (1, 1, 0)
+    assert plan_batch(5, (4, 16)) == (4, 4, 0)
+    assert plan_batch(2, (4, 16)) == (2, 4, 2)   # deadline flush pads
+    assert plan_batch(40, (1, 4, 16)) == (16, 16, 0)
+
+
+# -- micro-batcher -----------------------------------------------------
+
+FAST = 0.02  # flush deadline used across batcher tests, seconds
+
+
+def _reqs(n):
+    return [Request(np.full((2,), i, np.uint8)) for i in range(n)]
+
+
+def test_batcher_coalesces_full_largest_bucket():
+    b = MicroBatcher((1, 4), max_queue=16, max_latency_s=10.0)
+    for r in _reqs(5):
+        assert b.admit(r)
+    # 5 pending >= largest bucket: dispatch is immediate, no deadline
+    reqs, bucket = b.next_batch(timeout_s=0.5)
+    assert bucket == 4 and len(reqs) == 4
+    assert b.depth() == 1
+
+
+def test_batcher_flush_deadline_releases_partial_batch():
+    b = MicroBatcher((4, 16), max_queue=16, max_latency_s=FAST)
+    t0 = time.monotonic()
+    assert b.admit(Request(np.zeros(2, np.uint8)))
+    reqs, bucket = b.next_batch(timeout_s=2.0)
+    waited = time.monotonic() - t0
+    # released by the deadline, not the timeout: padded to the
+    # smallest bucket
+    assert bucket == 4 and len(reqs) == 1
+    assert FAST * 0.5 <= waited < 1.0
+
+
+def test_batcher_timeout_returns_none_and_keeps_pending():
+    b = MicroBatcher((4,), max_queue=16, max_latency_s=0.5)
+    assert b.next_batch(timeout_s=0.01) is None      # empty queue
+    assert b.admit(Request(np.zeros(2, np.uint8)))
+    # pending but not yet due: the driver gets its health-tick chance
+    # and the request stays queued for a later call
+    assert b.next_batch(timeout_s=0.01) is None
+    assert b.depth() == 1
+    reqs, bucket = b.next_batch(timeout_s=2.0)       # deadline flush
+    assert len(reqs) == 1 and bucket == 4
+
+
+def test_batcher_backpressure_refuses_at_bound():
+    b = MicroBatcher((1,), max_queue=2, max_latency_s=FAST)
+    assert b.admit(Request(np.zeros(2, np.uint8)))
+    assert b.admit(Request(np.zeros(2, np.uint8)))
+    assert not b.admit(Request(np.zeros(2, np.uint8)))  # shed, not grown
+    assert b.depth() == 2
+
+
+def test_batcher_requeue_puts_batch_back_in_order():
+    b = MicroBatcher((4,), max_queue=8, max_latency_s=FAST)
+    first = _reqs(4)
+    for r in first:
+        b.admit(r)
+    straggler = Request(np.full((2,), 9, np.uint8))
+    b.admit(straggler)
+    reqs, _ = b.next_batch(timeout_s=1.0)
+    assert reqs == first
+    # the world changed mid-dispatch: the batch goes back to the FRONT
+    b.requeue(reqs)
+    again, _ = b.next_batch(timeout_s=1.0)
+    assert again == first
+    assert b.depth() == 1  # the straggler kept its place behind them
+
+
+def test_batcher_close_drains_and_refuses():
+    b = MicroBatcher((4,), max_queue=8, max_latency_s=FAST)
+    queued = _reqs(3)
+    for r in queued:
+        b.admit(r)
+    assert b.close() == queued
+    assert not b.admit(Request(np.zeros(2, np.uint8)))
+    assert b.next_batch(timeout_s=0.01) is None
+
+
+def test_request_wait_complete_fail():
+    r = Request(np.zeros(2, np.uint8))
+    assert not r.wait(timeout_s=0.01)
+    r.complete({"label": 3})
+    assert r.wait(timeout_s=0.01) and r.result == {"label": 3}
+    r2 = Request(np.zeros(2, np.uint8))
+    r2.fail(RuntimeError("boom"))
+    assert r2.wait(timeout_s=0.01) and isinstance(r2.error, RuntimeError)
+
+
+# -- ServingTier HTTP round trip (stub infer, no JAX) -------------------
+
+SHAPE = (4, 4)
+
+
+def _stub_infer(arr):
+    # label = the row's max pixel; proves per-row payloads arrive intact
+    return (arr.reshape(arr.shape[0], -1).max(axis=1).astype(np.int32),
+            np.full((arr.shape[0],), 0.5, np.float64))
+
+
+def _post(port, image, timeout=5.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps({"image": image}).encode())
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _make_tier(**kw):
+    args = dict(infer_fn=_stub_infer, sample_shape=SHAPE,
+                sample_dtype=np.uint8, buckets=(1, 4), max_queue=8,
+                max_latency_s=0.01, port=0, request_timeout_s=5.0)
+    args.update(kw)
+    return ServingTier(**args)
+
+
+def _serve_in_thread(tier):
+    t = threading.Thread(target=tier.run, daemon=True)
+    t.start()
+    return t
+
+
+def test_tier_e2e_round_trip_and_livez():
+    tier = _make_tier()
+    tier.start()
+    driver = _serve_in_thread(tier)
+    try:
+        img = np.full(SHAPE, 7, np.uint8).tolist()
+        status, body = _post(tier.port, img)
+        assert status == 200
+        assert body["label"] == 7 and body["bucket"] in (1, 4)
+        assert body["latency_ms"] >= 0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{tier.port}/livez", timeout=5) as r:
+            live = json.loads(r.read())
+        assert live["ok"] and live["answered"] >= 1
+    finally:
+        tier.close()
+        driver.join(timeout=5)
+        assert not driver.is_alive()
+
+
+def test_tier_rejects_bad_shape_and_bad_json():
+    tier = _make_tier()
+    tier.start()
+    driver = _serve_in_thread(tier)
+    try:
+        status, body = _post(tier.port, [[1, 2], [3, 4]])
+        assert status == 400 and "shape" in body["error"]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{tier.port}/predict", data=b"not json")
+        try:
+            urllib.request.urlopen(req, timeout=5)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        tier.close()
+        driver.join(timeout=5)
+
+
+def test_tier_sheds_with_503_when_queue_full():
+    """Backpressure end to end: with the driver NOT running, the
+    bounded queue fills and every further request is answered 503
+    immediately — shed and counted, never hung."""
+    tier = _make_tier(max_queue=2)
+    tier.start()  # listener up, driver deliberately not started
+    try:
+        img = np.zeros(SHAPE, np.uint8).tolist()
+        results = []
+        threads = [threading.Thread(
+            target=lambda: results.append(_post(tier.port, img, 5.0)))
+            for _ in range(4)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        # the two overflow requests must answer promptly; the two
+        # queued ones are still waiting on the (absent) driver
+        deadline = time.monotonic() + 5.0
+        while len(results) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(results) >= 2, "overflow requests hung instead of shed"
+        assert all(code == 503 for code, _ in results)
+        assert all("queue full" in body["error"] for _, body in results)
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        tier.close()  # fails the two queued requests with shutdown
+        for t in threads:
+            t.join(timeout=5)
+
+
+def test_tier_infer_failure_fails_batch_but_keeps_serving():
+    calls = {"n": 0}
+
+    def flaky(arr):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("injected")
+        return _stub_infer(arr)
+
+    tier = _make_tier(infer_fn=flaky)
+    tier.start()
+    driver = _serve_in_thread(tier)
+    try:
+        img = np.full(SHAPE, 3, np.uint8).tolist()
+        status, body = _post(tier.port, img)
+        assert status == 500 and "injected" in body["error"]
+        status, body = _post(tier.port, img)   # the tier survived
+        assert status == 200 and body["label"] == 3
+    finally:
+        tier.close()
+        driver.join(timeout=5)
+
+
+def test_tier_set_infer_swap_answers_queued_requests():
+    """The elastic shrink-while-serving shape, simulated: requests
+    queued while the replica is down (infer swapped to a failing stub =
+    the reconfigure window) are answered by the REBUILT replica after
+    set_infer — queued work survives the world change."""
+    tier = _make_tier(max_latency_s=0.005)
+    tier.start()  # no driver yet: this is the reconfigure window
+    img = np.full(SHAPE, 5, np.uint8).tolist()
+    results = []
+    threads = [threading.Thread(
+        target=lambda: results.append(_post(tier.port, img, 10.0)))
+        for _ in range(3)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 5.0
+    while tier.batcher.depth() < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert tier.batcher.depth() == 3
+    # the rebuilt replica comes up and the driver resumes
+    tier.set_infer(_stub_infer)
+    driver = _serve_in_thread(tier)
+    try:
+        for t in threads:
+            t.join(timeout=10)
+        assert len(results) == 3
+        assert all(code == 200 and body["label"] == 5
+                   for code, body in results)
+    finally:
+        tier.close()
+        driver.join(timeout=5)
+
+
+def test_tier_max_requests_stops_driver():
+    tier = _make_tier(max_requests=2)
+    tier.start()
+    driver = _serve_in_thread(tier)
+    try:
+        img = np.zeros(SHAPE, np.uint8).tolist()
+        assert _post(tier.port, img)[0] == 200
+        assert _post(tier.port, img)[0] == 200
+        driver.join(timeout=5)
+        assert not driver.is_alive()   # answered its quota and stopped
+        assert tier.answered == 2
+    finally:
+        tier.close()
+
+
+# -- JAX-backed contracts ----------------------------------------------
+
+@pytest.fixture(scope="module")
+def mlp_serving():
+    """A tiny trained-for-zero-epochs mlp engine + replicated state on
+    the synthetic dataset: enough to pin predict_step semantics."""
+    from distributedpytorch_tpu import runtime, utils
+    from distributedpytorch_tpu.cli import (_build_engine, _place_state)
+    from distributedpytorch_tpu.config import Config
+    from distributedpytorch_tpu.data.datasets import load_dataset
+
+    cfg = Config(action="serve", data_path="/tmp/nodata",
+                 rsl_path="/tmp/serve_unit", dataset="synthetic",
+                 model_name="mlp", batch_size=8, debug=True,
+                 half_precision=False)
+    dataset = load_dataset("synthetic", cfg.data_path, cfg.seed,
+                           debug=True)
+    mesh = runtime.make_serve_mesh()
+    engine = _build_engine(cfg, "mlp", dataset, steps_per_epoch=1,
+                           mesh=mesh)
+    state = _place_state(engine.init_state(utils.root_key(cfg.seed)),
+                         mesh, cfg)
+    return cfg, dataset, engine, state
+
+
+def test_predict_step_padded_rows_are_inert(mlp_serving):
+    """The planner's correctness claim: a short batch padded with zero
+    rows answers the real rows EXACTLY as the unpadded batch would —
+    eval-mode apply makes every output row a function of its own input
+    row only."""
+    _cfg, dataset, engine, state = mlp_serving
+    images = dataset.splits["test"].images[:3]
+    labels_exact, confs_exact = engine.predict_step(state, images)
+    padded = np.zeros((8,) + images.shape[1:], images.dtype)
+    padded[:3] = images
+    labels_pad, confs_pad = engine.predict_step(state, padded)
+    np.testing.assert_array_equal(np.asarray(labels_pad)[:3],
+                                  np.asarray(labels_exact))
+    np.testing.assert_allclose(np.asarray(confs_pad)[:3],
+                               np.asarray(confs_exact), rtol=1e-6)
+
+
+def test_predict_step_confidence_is_max_softmax(mlp_serving):
+    _cfg, dataset, engine, state = mlp_serving
+    images = dataset.splits["test"].images[:4]
+    labels, confs = engine.predict_step(state, images)
+    labels, confs = np.asarray(labels), np.asarray(confs)
+    assert labels.shape == (4,) and labels.dtype == np.int32
+    assert np.all((0 < confs) & (confs <= 1.0))
+    assert np.all((0 <= labels) & (labels < dataset.nb_classes))
+
+
+def test_restore_for_serving_cross_layout(tmp_path, mlp_serving):
+    """A scan-layout vit checkpoint restores into a PLAIN vit serving
+    template (layout converted at load) and predicts identically to
+    the scan engine that wrote it — the any-checkpoint contract."""
+    from distributedpytorch_tpu import checkpoint as ckpt
+    from distributedpytorch_tpu import runtime, utils
+    from distributedpytorch_tpu.cli import (_build_engine, _place_state)
+    from distributedpytorch_tpu.config import Config
+    from distributedpytorch_tpu.data.datasets import load_dataset
+
+    dataset = load_dataset("synthetic", "/tmp/nodata", 42, debug=True)
+    mesh = runtime.make_serve_mesh()
+
+    def build(scan_layers):
+        cfg = Config(action="serve", data_path="/tmp/nodata",
+                     rsl_path=str(tmp_path), dataset="synthetic",
+                     model_name="vit", batch_size=8, debug=True,
+                     half_precision=False, scan_layers=scan_layers)
+        engine = _build_engine(cfg, "vit", dataset, steps_per_epoch=1,
+                               mesh=mesh)
+        state = _place_state(engine.init_state(utils.root_key(42)),
+                             mesh, cfg)
+        return cfg, engine, state
+
+    _, scan_engine, scan_state = build(scan_layers=True)
+    path = str(tmp_path / "bestmodel-synthetic-vit.ckpt")
+    ckpt.save_checkpoint(path, "vit", scan_state, epoch=0,
+                         best_valid_loss=1.0)
+
+    cfg_plain, plain_engine, template = build(scan_layers=False)
+    restored, epoch = ckpt.restore_for_serving(path, template)
+    assert epoch == 0
+    restored = _place_state(restored, mesh, cfg_plain)
+
+    images = dataset.splits["test"].images[:4]
+    labels_scan, confs_scan = scan_engine.predict_step(scan_state,
+                                                       images)
+    labels_plain, confs_plain = plain_engine.predict_step(restored,
+                                                          images)
+    np.testing.assert_array_equal(np.asarray(labels_plain),
+                                  np.asarray(labels_scan))
+    np.testing.assert_allclose(np.asarray(confs_plain),
+                               np.asarray(confs_scan), atol=1e-5)
+
+
+def test_tier_with_real_engine_round_trip(mlp_serving):
+    """In-process e2e with the REAL predict program behind the HTTP
+    front end: the cli.run_serve infer-closure shape, minus the CLI."""
+    import jax
+
+    from distributedpytorch_tpu import runtime
+
+    _cfg, dataset, engine, state = mlp_serving
+    mesh = runtime.make_serve_mesh()
+    n_dev = int(mesh.devices.size)
+
+    def infer(arr):
+        sh = (runtime.data_sharding(mesh) if arr.shape[0] % n_dev == 0
+              else runtime.replicated_sharding(mesh))
+        labels, confs = engine.predict_step(state,
+                                            jax.device_put(arr, sh))
+        with runtime.sanctioned_host_transfer():
+            return np.asarray(labels), np.asarray(confs)
+
+    images = dataset.splits["test"].images
+    tier = ServingTier(infer, images.shape[1:], images.dtype,
+                       buckets=(1, 4), max_queue=8, max_latency_s=0.01,
+                       port=0, request_timeout_s=30.0)
+    tier.start()
+    driver = _serve_in_thread(tier)
+    try:
+        status, body = _post(tier.port, images[0].tolist(), timeout=30.0)
+        assert status == 200
+        assert 0 <= body["label"] < dataset.nb_classes
+        assert 0 < body["confidence"] <= 1.0
+    finally:
+        tier.close()
+        driver.join(timeout=5)
