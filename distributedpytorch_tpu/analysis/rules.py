@@ -1508,6 +1508,98 @@ class UnboundedQueueInServer(Rule):
                         f"why growth is bounded here")
 
 
+# -- 16. unbounded-metric-cardinality ---------------------------------
+
+class UnboundedMetricCardinality(Rule):
+    """A metric name built by interpolating a runtime value — a request
+    id, a rank, a path, a hostname — mints a NEW series per distinct
+    value.  The registry (telemetry.Telemetry keeps one object per
+    name), every scrape body, and every downstream collector grow
+    without bound: the classic exporter-OOM, and the fleet collector
+    re-exports whatever the ranks mint, so one bad name multiplies by
+    the world size (ISSUE 16).  Identity belongs in a LABEL with a
+    bounded value set, or in the event's attrs — never in the series
+    name.
+
+    A finding is a call to ``counter()`` / ``gauge()`` / ``histogram()``
+    (any receiver: ``tel.counter``, ``telemetry.get().histogram``) — or
+    a ``Histogram(...)`` construction — whose name argument is built at
+    call time: an f-string with at least one interpolated field, a
+    ``"..." % x`` format, a ``"...".format(...)`` call, or a string
+    concatenation involving a non-literal.  A constant name, however
+    composed of literals, is fine.
+
+    Deliberate exceptions carry a rationale comment on the line or the
+    line above (same contract as wall-clock-in-measurement): e.g. a
+    name interpolated from a FIXED enum the comment enumerates."""
+
+    name = "unbounded-metric-cardinality"
+    description = ("metric/series name interpolated from runtime values "
+                   "in telemetry/serving/fleet code — per-value series "
+                   "grow the registry and every scrape without bound; "
+                   "use a bounded label or attrs instead")
+    TARGET_BASENAMES = {"telemetry.py", "goodput.py", "fleet.py",
+                        "tracing.py", "slo.py"}
+    METRIC_CALLS = {"counter", "gauge", "histogram"}
+
+    _has_rationale = BlockingH2dInStepLoop._has_rationale
+
+    def _targets(self, mod: Module) -> bool:
+        return (mod.basename in self.TARGET_BASENAMES
+                or "serving" in mod.rel.replace("\\", "/").split("/")[:-1])
+
+    def _dynamic(self, node: ast.AST) -> Optional[str]:
+        """How the name is built at call time, or None for static."""
+        if isinstance(node, ast.JoinedStr):
+            if any(isinstance(v, ast.FormattedValue)
+                   for v in node.values):
+                return "an f-string interpolation"
+            return None  # f-string with no fields: static after all
+        if isinstance(node, ast.BinOp):
+            if isinstance(node.op, ast.Mod):
+                return "a %-format"
+            if isinstance(node.op, ast.Add):
+                left = self._dynamic(node.left)
+                right = self._dynamic(node.right)
+                if left or right:
+                    return left or right
+                if not (isinstance(node.left, ast.Constant)
+                        and isinstance(node.right, ast.Constant)):
+                    return "a runtime string concatenation"
+            return None
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "format":
+            return "a .format() call"
+        return None
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            if not self._targets(mod):
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                callee = last_seg(call_name(node))
+                if callee.lower() not in self.METRIC_CALLS \
+                        and callee != "Histogram":
+                    continue
+                how = self._dynamic(node.args[0])
+                if how is None:
+                    continue
+                if self._has_rationale(mod, node.lineno):
+                    continue
+                yield self.finding(
+                    mod, node.lineno,
+                    f"metric name passed to {callee}() is built by "
+                    f"{how}: every distinct runtime value mints a new "
+                    f"series, growing the registry and every scrape "
+                    f"body without bound (and the fleet re-export "
+                    f"multiplies it by world size) — move the identity "
+                    f"into a bounded label/attrs, or comment why the "
+                    f"value set is fixed")
+
+
 RULES = (
     HostSyncInStepLoop(),
     TraceImpurity(),
@@ -1524,6 +1616,7 @@ RULES = (
     WallClockInMeasurement(),
     BlockingH2dInStepLoop(),
     UnboundedQueueInServer(),
+    UnboundedMetricCardinality(),
 )
 
 RULES_BY_NAME = {r.name: r for r in RULES}

@@ -21,7 +21,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import checkpoint as ckpt
 from . import costs, elastic, faults, flightrec, goodput, parallel, \
-    runtime, telemetry, utils
+    runtime, telemetry, tracing, utils
 from .config import Config, config_from_argv
 from .data import augment  # noqa: F401  (re-exported for drivers/tests)
 from .data.datasets import Dataset, Split, load_dataset
@@ -1535,6 +1535,10 @@ def run_serve(cfg: Config) -> dict:
     # queue gauges are the tier's operational surface (/metrics renders
     # only enabled telemetry), not an opt-in debugging aid.
     tel = telemetry.configure(cfg.rsl_path, True)
+    # Request tracing is always on in serve mode, same rationale: the
+    # per-request span chain (trace-rank<N>.jsonl) is the tier's
+    # incident surface, not an opt-in debugging aid.
+    tracing.configure(cfg.rsl_path, True, rank=runtime.process_index())
     flightrec.configure(cfg.rsl_path, cfg.flightrec,
                         rank=runtime.process_index(),
                         ring_size=cfg.flightrec_ring)
@@ -1648,6 +1652,7 @@ def run_serve(cfg: Config) -> dict:
     finally:
         if tier is not None:
             tier.close()
+        tracing.get().close()
         flightrec.get().close(
             "crash" if sys.exc_info()[0] is not None else "run_end")
         goodput.stop_exporter()
@@ -1709,6 +1714,19 @@ def main(argv=None) -> int:
         except ValueError as e:
             logging.error(f"{e}, exiting...")
             return 1
+        return 0
+    if cfg.action == "fleet":
+        # The standalone fleet collector (fleet.py): scrape every rank
+        # exporter, merge, re-export, evaluate SLOs — a monitoring
+        # process, never a member of the world, no JAX backend touched.
+        from . import fleet
+
+        return fleet.run_cli(cfg)
+    if cfg.action == "incidents":
+        # Offline digest of the incident bundles a fleet run wrote.
+        from . import slo
+
+        print(slo.incidents_report(cfg.rsl_path))
         return 0
     if cfg.action == "bench-trend":
         # Regression ledger over the checked-in BENCH history; the

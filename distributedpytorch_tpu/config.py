@@ -298,6 +298,21 @@ class Config:
     serve_queue: int = 256
     serve_request_timeout: float = 30.0
     serve_max_requests: int = 0
+    # 'fleet' subcommand (fleet.py, ISSUE 16): the standalone collector
+    # scrapes the per-rank exporters at metrics_port..metrics_port +
+    # fleet_ranks - 1 every fleet_interval seconds, ages a silent rank
+    # out of the merged series after fleet_stale_after consecutive
+    # failed scrapes, re-exports fleet /metrics + /fleet on fleet_port,
+    # and (with --slo-spec) evaluates burn-rate objectives, writing one
+    # incident-*.json bundle per newly-firing objective.
+    # fleet_max_cycles bounds the run for gates (0 = run until ^C);
+    # slo_spec also feeds 'incidents' for offline re-reporting.
+    fleet_ranks: int = 1
+    fleet_port: int = 9200
+    fleet_interval: float = 1.0
+    fleet_stale_after: int = 3
+    fleet_max_cycles: int = 0
+    slo_spec: Optional[str] = None
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -772,6 +787,66 @@ def build_parser() -> argparse.ArgumentParser:
     p_bt.add_argument("--json", action="store_true",
                       help="machine-readable verdict output")
 
+    # Fleet collector (fleet.py, ISSUE 16) — a standalone process, no
+    # JAX backend: scrapes every rank exporter, merges the series
+    # (counters by sum, latency sketches bucket-wise), re-exports them,
+    # and turns --slo-spec objectives into incident bundles.
+    p_fleet = sub.add_parser(
+        "fleet", help="run the fleet metrics collector: scrape all "
+                      "rank /metrics+/healthz exporters, merge into "
+                      "fleet-level series (elastic-aware), re-export "
+                      "/metrics + /fleet, evaluate --slo-spec "
+                      "burn-rate objectives into incident bundles")
+    p_fleet.add_argument("--rsl_path", type=str, default=RSL_PATH,
+                         help=f"run directory shared with the serve "
+                              f"world: fleet-metrics.jsonl and "
+                              f"incident-*.json land here, trace "
+                              f"records are mined from here "
+                              f"(default: {RSL_PATH})")
+    p_fleet.add_argument("--metrics-port", type=int, default=9100,
+                         dest="metricsPort", metavar="PORT",
+                         help="base port of the per-rank exporters to "
+                              "scrape (rank r answers on PORT + r; "
+                              "default 9100)")
+    p_fleet.add_argument("--ranks", type=int, default=1,
+                         dest="fleetRanks", metavar="N",
+                         help="candidate rank count: ports PORT..PORT+"
+                              "N-1 are probed every cycle, so elastic "
+                              "joiners appear within one interval "
+                              "(default 1)")
+    p_fleet.add_argument("--fleet-port", type=int, default=9200,
+                         dest="fleetPort", metavar="PORT",
+                         help="serve the merged fleet /metrics (Prom "
+                              "text) and /fleet (JSON) here "
+                              "(default 9200; 0 disables re-export)")
+    p_fleet.add_argument("--interval", type=float, default=1.0,
+                         dest="fleetInterval", metavar="S",
+                         help="scrape cycle period in seconds "
+                              "(default 1.0)")
+    p_fleet.add_argument("--stale-after", type=int, default=3,
+                         dest="fleetStaleAfter", metavar="N",
+                         help="consecutive failed scrapes before a "
+                              "rank ages out of the merged series "
+                              "(default 3)")
+    p_fleet.add_argument("--max-cycles", type=int, default=0,
+                         dest="fleetMaxCycles", metavar="N",
+                         help="stop after N scrape cycles (0 = run "
+                              "until interrupted; gates use N)")
+    p_fleet.add_argument("--slo-spec", type=str, default=None,
+                         dest="sloSpec", metavar="FILE",
+                         help="JSON file declaring SLO objectives "
+                              "(slo.py schema); firing objectives "
+                              "write incident-*.json bundles")
+
+    # Offline incident digest — reads RSL_PATH/incident-*.json written
+    # by a fleet run; no flags beyond the run dir.
+    p_inc = sub.add_parser(
+        "incidents", help="report the SLO incident bundles a fleet "
+                          "collector wrote for this run")
+    p_inc.add_argument("--rsl_path", type=str, default=RSL_PATH,
+                       help=f"run directory holding incident-*.json "
+                            f"(default: {RSL_PATH})")
+
     # Static analysis (analysis/ graftlint) — no JAX backend touched.
     p_lint = sub.add_parser(
         "lint", help="run the graftlint static analysis pass "
@@ -802,6 +877,17 @@ def config_from_argv(argv=None) -> Config:
         return Config(action="bench-trend", trend_dir=args.dir,
                       trend_threshold=args.threshold,
                       report_json=args.json)
+    if args.action == "fleet":
+        return Config(action="fleet", rsl_path=args.rsl_path,
+                      metrics_port=args.metricsPort,
+                      fleet_ranks=args.fleetRanks,
+                      fleet_port=args.fleetPort,
+                      fleet_interval=args.fleetInterval,
+                      fleet_stale_after=args.fleetStaleAfter,
+                      fleet_max_cycles=args.fleetMaxCycles,
+                      slo_spec=args.sloSpec)
+    if args.action == "incidents":
+        return Config(action="incidents", rsl_path=args.rsl_path)
     if args.action == "lint":
         return Config(action="lint", lint_json=args.json,
                       lint_paths=tuple(args.paths))

@@ -54,6 +54,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import os
 import threading
 import time
@@ -457,6 +458,27 @@ class MetricsExporter:
                                  % (m, q, h.quantile(q)))
                 lines.append("%s_count %d" % (m, h.count))
                 lines.append("%s_sum %.17g" % (m, h.sum))
+                if h.count:
+                    # The sketch itself, as cumulative Prometheus-style
+                    # buckets: le = the geometric upper boundary
+                    # exp((idx+1)*log(1.02)).  Summary quantiles don't
+                    # merge across ranks; these buckets do — the fleet
+                    # collector reconstructs the sketch from this block
+                    # (telemetry.Histogram.from_parts) and merge()s it
+                    # bucket-wise, which is exact.
+                    lines.append("%s_min %.17g" % (m, h.min))
+                    lines.append("%s_max %.17g" % (m, h.max))
+                    cum = h._nonpos
+                    if cum:
+                        lines.append('%s_bucket{le="0"} %d' % (m, cum))
+                    growth = telemetry.Histogram._GROWTH_LOG
+                    for idx in sorted(h._buckets):
+                        cum += h._buckets[idx]
+                        lines.append(
+                            '%s_bucket{le="%.17g"} %d'
+                            % (m, math.exp((idx + 1) * growth), cum))
+                    lines.append('%s_bucket{le="+Inf"} %d'
+                                 % (m, h.count))
         if gp.enabled:
             m = "dpt_goodput_seconds_total"
             lines.append("# TYPE %s counter" % m)

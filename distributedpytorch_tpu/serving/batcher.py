@@ -39,16 +39,21 @@ class QueueFullError(RuntimeError):
 
 
 class Request:
-    """One in-flight request: payload in, result or error out."""
+    """One in-flight request: payload in, result or error out.  ``trace``
+    is the request's span chain (tracing.RequestTrace) when tracing is
+    on — the batcher stamps the queue-side transitions, the server the
+    infer/respond ones."""
 
-    __slots__ = ("payload", "enqueued_mono", "result", "error", "_done")
+    __slots__ = ("payload", "enqueued_mono", "result", "error", "_done",
+                 "trace")
 
-    def __init__(self, payload: Any):
+    def __init__(self, payload: Any, trace: Any = None):
         self.payload = payload
         self.enqueued_mono = time.monotonic()
         self.result: Any = None
         self.error: Optional[BaseException] = None
         self._done = threading.Event()
+        self.trace = trace
 
     def complete(self, result: Any) -> None:
         self.result = result
@@ -97,6 +102,8 @@ class MicroBatcher:
             if self._closed or len(self._queue) >= self.max_queue:
                 return False
             req.enqueued_mono = time.monotonic()
+            if req.trace is not None:
+                req.trace.mark_admitted()  # queue_wait starts HERE
             self._queue.append(req)
             self._cond.notify()
             return True
@@ -138,6 +145,9 @@ class MicroBatcher:
                 self._cond.wait(wake - now)
             take, bucket, _pad = plan_batch(len(self._queue), self.buckets)
             reqs = [self._queue.popleft() for _ in range(take)]
+            for r in reqs:
+                if r.trace is not None:
+                    r.trace.mark_dequeued()  # queue_wait ends, batch_form starts
             return reqs, bucket
 
     def requeue(self, reqs: List[Request]) -> None:

@@ -43,7 +43,7 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .. import faults, telemetry
+from .. import faults, telemetry, tracing
 from .batcher import MicroBatcher, Request
 
 # Driver poll granularity: the upper bound on how stale a shutdown /
@@ -151,10 +151,13 @@ class ServingTier:
 
     # -- handler side (HTTP threads) ----------------------------------
 
-    def _respond(self, handler, code: int, payload: dict) -> None:
+    def _respond(self, handler, code: int, payload: dict,
+                 req_id: Optional[str] = None) -> None:
         body = json.dumps(payload).encode("utf-8")
         handler.send_response(code)
         handler.send_header("Content-Type", "application/json")
+        if req_id is not None:
+            handler.send_header("X-DPT-Request-Id", req_id)
         handler.send_header("Content-Length", str(len(body)))
         handler.end_headers()
         handler.wfile.write(body)
@@ -181,31 +184,50 @@ class ServingTier:
                 "error": f"image shape {list(arr.shape)} != expected "
                          f"{list(self.sample_shape)}"})
             return
-        req = Request(arr)
+        # Every valid request gets its deterministic id here; every
+        # answer below — 200, 503 shed, 504 timeout, 500 — carries it
+        # back as X-DPT-Request-Id, and its terminal record lands in
+        # trace-rank<N>.jsonl (tracing.py).
+        trace = tracing.get().start()
+        rid = trace.id if trace is not None else None
+        req = Request(arr, trace=trace)
         try:
             faults.fire("serve.admit")
             admitted = self.batcher.admit(req)
         except OSError as e:
             tel.counter("serve/failed").add()
-            self._respond(handler, 500, {"error": repr(e)})
+            self._respond(handler, 500, {"error": repr(e)}, req_id=rid)
+            if trace is not None:
+                trace.finish(500, "failed", error=repr(e))
             return
         if not admitted:
             # THE backpressure answer: shed now, while the client can
             # still retry elsewhere — a full queue must never grow.
             tel.counter("serve/shed").add()
+            depth = self.batcher.depth()
             self._respond(handler, 503, {
                 "error": "queue full",
-                "queue_depth": self.batcher.depth()})
+                "queue_depth": depth}, req_id=rid)
+            if trace is not None:
+                trace.finish(503, "shed", queue_depth=depth)
             return
         if not req.wait(self.request_timeout_s):
             tel.counter("serve/timeout").add()
-            self._respond(handler, 504, {"error": "request timed out"})
+            self._respond(handler, 504, {"error": "request timed out"},
+                          req_id=rid)
+            if trace is not None:
+                trace.finish(504, "timeout")
             return
         if req.error is not None:
-            self._respond(handler, 503 if self._stop.is_set() else 500,
-                          {"error": repr(req.error)})
+            code = 503 if self._stop.is_set() else 500
+            self._respond(handler, code, {"error": repr(req.error)},
+                          req_id=rid)
+            if trace is not None:
+                trace.finish(code, "failed", error=repr(req.error))
             return
-        self._respond(handler, 200, req.result)
+        self._respond(handler, 200, req.result, req_id=rid)
+        if trace is not None:
+            trace.finish(200, "answered")
 
     # -- driver side (run() caller's thread) --------------------------
 
@@ -242,6 +264,9 @@ class ServingTier:
         arr = np.zeros((bucket,) + self.sample_shape, self.sample_dtype)
         for i, r in enumerate(reqs):
             arr[i] = r.payload
+        for r in reqs:
+            if r.trace is not None:
+                r.trace.mark_infer_start(bucket)
         t0 = time.perf_counter()
         try:
             faults.fire("serve.infer")
@@ -255,6 +280,8 @@ class ServingTier:
             self.answered += len(reqs)
             logging.error(f"serve: micro-batch of {len(reqs)} failed: {e}")
             for r in reqs:
+                if r.trace is not None:
+                    r.trace.mark_infer_end()
                 r.fail(e)
             return
         infer_ms = (time.perf_counter() - t0) * 1000.0
@@ -266,6 +293,9 @@ class ServingTier:
         for i, r in enumerate(reqs):
             latency_ms = r.age_s() * 1000.0
             tel.histogram("serve/request_latency_ms").observe(latency_ms)
+            if r.trace is not None:
+                r.trace.mark_infer_end()
+                r.trace.note_latency(latency_ms)
             r.complete({
                 "label": int(labels[i]),
                 "confidence": round(float(confs[i]), 6),

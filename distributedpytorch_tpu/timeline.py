@@ -60,13 +60,14 @@ import os
 import statistics
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import flightrec, goodput, telemetry
+from . import flightrec, goodput, telemetry, tracing
 
 # Thread ids within each rank's process row.
 _TID_SPANS = 0      # telemetry spans
 _TID_STEPS = 1      # flight-recorder per-step records
 _TID_EVENTS = 2     # point events / instants
 _TID_GOODPUT = 3    # goodput ledger: per-epoch category attribution
+_TID_REQUESTS = 4   # serving tier: per-request trace span chains
 
 
 def _attrs(ev: Dict[str, Any]) -> Dict[str, Any]:
@@ -292,8 +293,12 @@ def build_timeline(rsl_path: str) -> Dict[str, Any]:
     events = telemetry.load_events(os.path.join(rsl_path, "telemetry"))
     dumps = flightrec.load_dumps(rsl_path)
     ledgers = goodput.load_ledgers(rsl_path)
+    requests = [r for r in tracing.load_records(rsl_path)
+                if isinstance(r.get("rank"), int)
+                and isinstance(r.get("mono_admit"), (int, float))]
     ranks = sorted({int(ev["rank"]) for ev in events
-                    if isinstance(ev.get("rank"), int)} | set(dumps))
+                    if isinstance(ev.get("rank"), int)} | set(dumps)
+                   | {int(r["rank"]) for r in requests})
     if not ranks:
         raise ValueError(
             f"telemetry under {rsl_path!r} has no rank-stamped events; "
@@ -397,6 +402,9 @@ def build_timeline(rsl_path: str) -> Dict[str, Any]:
         for row in _goodput_rows(doc):
             # Ledger rows carry END stamps; the slice starts wall_s back.
             stamps.append(aligned(r, row["mono"] - row["wall_s"]))
+    for rec in requests:
+        stamps.append(aligned(int(rec["rank"]), float(rec["mono_admit"]),
+                              rec.get("ts_admit")))
     if not stamps:
         raise ValueError(
             f"no timestamped records under {rsl_path!r}; nothing to plot")
@@ -414,8 +422,12 @@ def build_timeline(rsl_path: str) -> Dict[str, Any]:
         for tid, label in ((_TID_SPANS, "telemetry spans"),
                            (_TID_STEPS, "flightrec steps"),
                            (_TID_EVENTS, "events"),
-                           (_TID_GOODPUT, "goodput categories")):
+                           (_TID_GOODPUT, "goodput categories"),
+                           (_TID_REQUESTS, "requests")):
             if tid == _TID_GOODPUT and r not in ledgers:
+                continue
+            if tid == _TID_REQUESTS and not any(
+                    int(rec["rank"]) == r for rec in requests):
                 continue
             trace_events.append({"ph": "M", "name": "thread_name",
                                  "pid": r, "tid": tid,
@@ -496,6 +508,29 @@ def build_timeline(rsl_path: str) -> Dict[str, Any]:
                 "pid": r, "tid": _TID_GOODPUT, "ts": start,
                 "args": cats,
             })
+    # Per-request track (serving tier, tracing.py): each request's span
+    # chain laid out sequentially from its admission stamp — the chain
+    # property (sum(spans) == total_s) means the slices tile exactly,
+    # so queue_wait vs batch_form vs infer reads directly off the row.
+    for rec in requests:
+        r = int(rec["rank"])
+        t = float(rec["mono_admit"])
+        wall = (float(rec["ts_admit"])
+                if isinstance(rec.get("ts_admit"), (int, float)) else None)
+        spans = rec.get("spans", {})
+        args = {k: rec[k] for k in ("id", "status", "outcome", "bucket",
+                                    "latency_ms") if k in rec}
+        for name in tracing.SPAN_ORDER:
+            dur = spans.get(name)
+            if not isinstance(dur, (int, float)) or dur < 0:
+                continue
+            trace_events.append({
+                "ph": "X", "cat": "request", "name": name,
+                "pid": r, "tid": _TID_REQUESTS,
+                "ts": us(r, t, wall), "dur": round(float(dur) * 1e6, 3),
+                "args": args,
+            })
+            t += float(dur)
     # Stable per-rank ordering: metadata first, then strictly by
     # (pid, ts) — Perfetto tolerates any order, humans and tests don't.
     trace_events.sort(key=lambda e: (e.get("pid", -1),

@@ -49,11 +49,19 @@ Four stages, all CPU and bounded:
      Replica 0 must then reconfigure (``elastic/reconfigure`` with
      ``purpose: "serve"`` and a 1-world) and KEEP ANSWERING on the
      same port, and SIGTERM must drain it to exit 0.
+  H. fleet (``--stage fleet``, its own gate.sh leg) — fleet-scope
+     observability (ISSUE 16): a ``main.py fleet`` collector scraping
+     a 2-rank serve world under a declarative error-rate SLO.  A
+     clean control run must produce ZERO incidents; an injected
+     ``serve.infer`` ioerror burst on replica 1 must trip the
+     multi-window burn rate into exactly ONE incident bundle naming
+     rank 1 and its failed request ids; a follow-up rank loss must
+     age the dead rank out of the fleet series (``dpt_up`` drops).
 
 Run as ``env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py``
 (stages A-D) or with ``--stage elastic`` / ``--stage grow`` /
-``--stage serve`` (one stage each).  The script re-execs itself with
-``--child`` for the multi-process stages' ranks.
+``--stage serve`` / ``--stage fleet`` (one stage each).  The script
+re-execs itself with ``--child`` for the multi-process stages' ranks.
 """
 
 import argparse
@@ -159,6 +167,18 @@ def main(stage: str = "core") -> int:
         print("chaos gate OK: serve replica survived the injected "
               "batch fault, the survivor reconfigured past the rank "
               "loss and kept answering, SIGTERM drained clean")
+        return 0
+
+    if stage == "fleet":
+        problems = _stage_fleet(work)
+        for p in problems:
+            print(f"PROBLEM: {p}", file=sys.stderr)
+        if problems:
+            return 1
+        print("chaos gate OK: fault burst tripped the error SLO into "
+              "exactly one incident naming the failing rank, the dead "
+              "rank aged out of the fleet series, and the clean "
+              "control produced zero incidents")
         return 0
 
     # -- stage A: fault-free reference --------------------------------
@@ -874,6 +894,263 @@ def _stage_serve(work: str) -> list:
     return problems
 
 
+def _stage_fleet(work: str) -> list:
+    """Stage H driver: the fleet collector (ISSUE 16) watching a 2-rank
+    serve world under a declarative error-rate SLO.  Clean control
+    first — both replicas scraped, zero incidents.  Then the fault
+    world: an injected ``serve.infer`` ioerror burst on replica 1 must
+    trip the multi-window burn rate into EXACTLY one incident bundle
+    naming rank 1 and its failed request ids, and a follow-up rank
+    loss must age the dead rank out of the fleet series (``dpt_up``
+    drops to 1 — never a stale self-report)."""
+    import signal
+    import socket
+    import urllib.request
+
+    from distributedpytorch_tpu import slo
+    from distributedpytorch_tpu.cli import run_train
+
+    problems = []
+    rsl = os.path.join(work, "fleetworld")
+    os.makedirs(rsl, exist_ok=True)
+    run_train(_base_cfg(rsl).replace(nb_epochs=1))
+    ckpt_file = os.path.join(rsl, "bestmodel-synthetic-mlp.ckpt")
+    if not os.path.exists(ckpt_file):
+        return [f"provenance training run left no checkpoint at "
+                f"{ckpt_file}"]
+
+    # Error-rate SLO: 90% target (10% budget), fast 2s window at 2x
+    # burn AND slow 8s window at 1x — both sized so a 12-failure burst
+    # against light clean traffic trips them within a few collector
+    # cycles, while the clean control never comes near.
+    spec_path = os.path.join(work, "slo.json")
+    with open(spec_path, "w") as f:
+        json.dump({"slos": [{
+            "name": "serve-errors", "kind": "ratio",
+            "bad": "dpt_serve_failed_total",
+            "total": "dpt_serve_requests_total",
+            "target": 0.9,
+            "windows": [{"seconds": 2.0, "burn": 2.0},
+                        {"seconds": 8.0, "burn": 1.0}]}]}, f)
+
+    def launch(tag: str, world_rsl: str, plan_path):
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            coord = f"localhost:{s.getsockname()[1]}"
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            base_port = s.getsockname()[1]
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            base_mport = s.getsockname()[1]
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            fport = s.getsockname()[1]
+        env = _child_env()
+        procs = []
+        for pid in range(2):
+            log = os.path.join(work, f"{tag}_rank{pid}.log")
+            out = open(log, "ab")
+            cmd = [sys.executable, os.path.abspath(__file__), "--child",
+                   "--serve", "--coord", coord, "--pid", str(pid),
+                   "--nprocs", "2", "--rsl", world_rsl,
+                   "--ckpt", ckpt_file, "--serve-port", str(base_port),
+                   "--metrics-port", str(base_mport), "--elastic"]
+            if plan_path:
+                cmd += ["--plan", plan_path]
+            procs.append((pid, subprocess.Popen(
+                cmd, cwd=REPO, env=env, stdout=out, stderr=out), log))
+        flog = os.path.join(work, f"{tag}_fleet.log")
+        coll = subprocess.Popen(
+            [sys.executable, "main.py", "fleet",
+             "--rsl_path", world_rsl,
+             "--metrics-port", str(base_mport), "--ranks", "2",
+             "--fleet-port", str(fport), "--interval", "0.25",
+             "--stale-after", "4", "--slo-spec", spec_path],
+            cwd=REPO, env=env, stdout=open(flog, "ab"),
+            stderr=subprocess.STDOUT)
+        return procs, coll, base_port, fport, flog
+
+    def fleet_doc(fport: int):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{fport}/fleet", timeout=5) as r:
+            return json.loads(r.read())
+
+    def wait_alive(fport: int, want: list, timeout_s: float = 60.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                doc = fleet_doc(fport)
+                if doc.get("alive") == want:
+                    return doc
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.25)
+        return None
+
+    def teardown(procs, coll, tag: str):
+        coll.terminate()
+        try:
+            coll.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            coll.kill()
+            coll.wait()
+        for _, p, _ in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for pid, p, _ in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=90)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    problems.append(f"{tag}: replica {pid} hung on "
+                                    f"SIGTERM")
+
+    # -- clean control: zero incidents --------------------------------
+    ctl_rsl = os.path.join(work, "control")
+    os.makedirs(ctl_rsl, exist_ok=True)
+    procs, coll, base_port, fport, flog = launch("ctl", ctl_rsl, None)
+    try:
+        for pid, p, log in procs:
+            if not _serve_wait_live(base_port + pid, p,
+                                    SERVE_LIVE_WAIT_S):
+                return [f"control replica {pid} never went live on "
+                        f":{base_port + pid}\n{_tail(log)}"]
+        if wait_alive(fport, [0, 1]) is None:
+            problems.append(f"control: collector never saw both "
+                            f"replicas alive\n{_tail(flog)}")
+        t_end = time.monotonic() + 4.0
+        while time.monotonic() < t_end and not problems:
+            for pid in range(2):
+                s, b = _serve_post(base_port + pid)
+                if s != 200:
+                    problems.append(f"control: replica {pid} answered "
+                                    f"{s} ({b}) on clean traffic")
+                    break
+            time.sleep(0.1)
+        time.sleep(1.0)  # a few more evaluation cycles on the tail
+        stray = slo.load_incidents(ctl_rsl)
+        if stray:
+            problems.append(f"control: {len(stray)} incident(s) on "
+                            f"CLEAN traffic, first slo: "
+                            f"{stray[0].get('slo')}")
+    finally:
+        teardown(procs, coll, "control")
+    if not os.path.exists(os.path.join(ctl_rsl, "fleet-metrics.jsonl")):
+        problems.append("control: collector persisted no "
+                        "fleet-metrics.jsonl")
+    if problems:
+        return problems
+    print("chaos gate H: clean control — both replicas scraped, zero "
+          "incidents")
+
+    # -- fault world: burst -> one incident, rank loss -> age-out -----
+    BURST_FAILS = 12
+    plan_path = os.path.join(work, "fleet_plan.json")
+    with open(plan_path, "w") as f:
+        json.dump({"faults": [
+            {"site": "serve.infer", "kind": "ioerror", "after_n": 1,
+             "count": BURST_FAILS, "rank": 1},
+            {"site": "serve.infer", "kind": "rank_loss",
+             "after_n": 1 + BURST_FAILS, "count": 1, "rank": 1},
+        ]}, f)
+    procs, coll, base_port, fport, flog = launch("fault", rsl,
+                                                 plan_path)
+    try:
+        for pid, p, log in procs:
+            if not _serve_wait_live(base_port + pid, p,
+                                    SERVE_LIVE_WAIT_S):
+                return [f"fault replica {pid} never went live on "
+                        f":{base_port + pid}\n{_tail(log)}"]
+        if wait_alive(fport, [0, 1]) is None:
+            problems.append(f"fault: collector never saw both "
+                            f"replicas alive\n{_tail(flog)}")
+        # baseline clean traffic (the burn rate needs a denominator);
+        # replica 1's first hit is clean — the burst starts at hit 2
+        s, _ = _serve_post(base_port + 1)
+        if s != 200:
+            problems.append(f"fault: replica 1's pre-burst request "
+                            f"answered {s}")
+        for _ in range(8):
+            _serve_post(base_port)
+            time.sleep(0.1)
+        # the burst: every replica-1 answer is the injected 500
+        codes = [_serve_post(base_port + 1)[0]
+                 for _ in range(BURST_FAILS)]
+        if codes != [500] * BURST_FAILS:
+            problems.append(f"fault: burst answered {codes}, expected "
+                            f"{BURST_FAILS} injected 500s")
+        # the SLO must fire and write its one bundle
+        bundles, deadline = [], time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            bundles = slo.load_incidents(rsl)
+            if bundles:
+                break
+            time.sleep(0.25)
+        if not bundles:
+            problems.append(f"fault: no incident bundle within 30s of "
+                            f"the burst\n{_tail(flog)}")
+        else:
+            b = bundles[0]
+            if b.get("slo") != "serve-errors":
+                problems.append(f"fault: incident names slo "
+                                f"{b.get('slo')!r}")
+            if b.get("suspect_ranks") != [1]:
+                problems.append(f"fault: incident suspects "
+                                f"{b.get('suspect_ranks')}, expected "
+                                f"[1]")
+            offs = b.get("offending_requests") or []
+            if not offs or not all(o.startswith("r1-") for o in offs):
+                problems.append(f"fault: offending request ids wrong: "
+                                f"{offs[:4]}")
+        # exactly ONE bundle per episode: several more collector
+        # cycles must not mint another
+        time.sleep(2.0)
+        n = len(slo.load_incidents(rsl))
+        if n != 1:
+            problems.append(f"fault: {n} incident bundles for one "
+                            f"episode, expected exactly 1")
+        # rank loss: the next replica-1 request dies with its socket
+        try:
+            s, b = _serve_post(base_port + 1, timeout=20.0)
+            problems.append(f"fault: replica 1's rank-loss request "
+                            f"ANSWERED ({s}, {b})")
+        except OSError:
+            pass
+        procs[1][1].wait(timeout=60)
+        # ...and the dead rank ages out of the fleet series
+        doc = wait_alive(fport, [0])
+        if doc is None:
+            problems.append(f"fault: dead rank 1 never aged out of "
+                            f"the fleet series\n{_tail(flog)}")
+        elif "1" in (doc.get("targets") or {}):
+            problems.append("fault: aged-out rank 1 still present in "
+                            "the fleet targets")
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{fport}/metrics",
+                    timeout=5) as r:
+                text = r.read().decode()
+            if not text.endswith("dpt_up 1\n"):
+                problems.append(f"fault: stale dpt_up after the rank "
+                                f"loss: ...{text[-40:]!r}")
+        except OSError as e:
+            problems.append(f"fault: fleet /metrics scrape after the "
+                            f"rank loss failed: {e}")
+        n = len(slo.load_incidents(rsl))
+        if n != 1:
+            problems.append(f"fault: rank loss minted extra incident "
+                            f"bundles ({n} total, expected 1)")
+    finally:
+        teardown(procs, coll, "fault")
+    if not problems:
+        print(f"chaos gate H: {BURST_FAILS}-failure burst -> one "
+              f"incident (rank 1, {BURST_FAILS} offender ids), rank "
+              f"loss aged out of the fleet series")
+    return problems
+
+
 def _tail(path: str, n: int = 2500) -> str:
     try:
         return open(path).read()[-n:]
@@ -910,7 +1187,8 @@ def child_main(a) -> int:
             action="serve", checkpoint_file=a.ckpt, fault_plan=a.plan,
             elastic=a.elastic, serve_port=a.serve_port,
             serve_buckets="1,4", serve_max_latency_ms=10.0,
-            serve_queue=16, health_timeout=20.0)
+            serve_queue=16, health_timeout=20.0,
+            metrics_port=a.metrics_port)
         try:
             run_serve(cfg)
         except (faults.FatalFaultError, faults.PeerFailureError) as e:
@@ -951,13 +1229,15 @@ def child_main(a) -> int:
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--stage", choices=("core", "elastic", "grow",
-                                        "serve"),
+                                        "serve", "fleet"),
                     default="core")
     ap.add_argument("--child", action="store_true")
     ap.add_argument("--join", action="store_true")
     ap.add_argument("--serve", action="store_true")
     ap.add_argument("--serve-port", type=int, default=0,
                     dest="serve_port")
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    dest="metrics_port")
     ap.add_argument("--coord")
     ap.add_argument("--pid", type=int)
     ap.add_argument("--nprocs", type=int, default=2)

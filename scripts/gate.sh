@@ -61,15 +61,24 @@
 #      scripts/bench_trend.py
 #  12. serve gate: a 2-bucket ``main.py serve`` replica under a real
 #      localhost load generator — client p95 + throughput floors, live
-#      dpt_serve_* /metrics scraped mid-load, saturation answered with
-#      counted 503 sheds (never hung clients), SIGTERM drain — see
-#      scripts/serve_gate.py and README "Serving"
+#      dpt_serve_* /metrics scraped mid-load, X-DPT-Request-Id on
+#      every 200 with trace records reconciling against client
+#      latencies, a fleet collector's merged series matching the
+#      per-replica scrape, saturation answered with counted 503 sheds
+#      (never hung clients), SIGTERM drain — see scripts/serve_gate.py
+#      and README "Serving"
 #  13. serve-chaos gate: two serve replicas in a 2-rank elastic gloo
 #      world; an injected batch ioerror answers 500 and the tier keeps
 #      serving, a rank_loss vanishes replica 1 mid-batch, the survivor
 #      reconfigures (purpose=serve) and keeps answering on its port —
 #      see scripts/chaos_gate.py --stage serve and README "Serving"
-#  14. the driver's own gate: __graft_entry__.dryrun_multichip(8)
+#  14. fleet gate: a ``main.py fleet`` collector over a 2-rank serve
+#      world under a declarative error-rate SLO — clean control writes
+#      zero incidents, an injected infer fault burst writes exactly
+#      one bundle naming the failing rank + its request ids, a rank
+#      loss ages out of the fleet series — see scripts/chaos_gate.py
+#      --stage fleet and README "Fleet observability & SLOs"
+#  15. the driver's own gate: __graft_entry__.dryrun_multichip(8)
 #      (clean env, exactly as the driver runs it)
 #
 # Tier map:
@@ -140,6 +149,9 @@ env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/serve_gate.py
 
 echo "== gate: serve-chaos (batch fault / rank loss / survivor) =="
 env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py --stage serve
+
+echo "== gate: fleet (SLO burn rate / incidents / age-out) =="
+env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py --stage fleet
 
 echo "== gate: dryrun_multichip(8) =="
 env -u XLA_FLAGS -u JAX_PLATFORMS python -c \

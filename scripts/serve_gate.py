@@ -22,6 +22,14 @@ generator over localhost HTTP.  Three stages, all bounded:
      hung, queue depth never past the bound — and the shed counter
      must land in /metrics.
 
+Stage A also proves the ISSUE-16 observability chain end to end:
+every 200 must carry an ``X-DPT-Request-Id`` header whose trace record
+(trace-rank0.jsonl) reconciles — span sum == total, pre-respond spans
+vs the latency histogram observation, and server total within the
+latency the CLIENT measured — and a real ``main.py fleet`` collector
+scraping the replica MID-load must re-export merged ``dpt_serve_*``
+series equal to the per-replica scrape from the same cycle.
+
 Run as ``env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/serve_gate.py``.
 """
 
@@ -71,16 +79,20 @@ def _env() -> dict:
 
 
 def _post(port: int, timeout: float = 35.0):
-    """One /predict round trip -> (status, body dict, client seconds)."""
+    """One /predict round trip -> (status, body dict, client seconds,
+    X-DPT-Request-Id header or None)."""
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}/predict",
         data=json.dumps({"image": SAMPLE}).encode())
     t0 = time.perf_counter()
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
-            return r.status, json.loads(r.read()), time.perf_counter() - t0
+            return (r.status, json.loads(r.read()),
+                    time.perf_counter() - t0,
+                    r.headers.get("X-DPT-Request-Id"))
     except urllib.error.HTTPError as e:
-        return e.code, json.loads(e.read()), time.perf_counter() - t0
+        return (e.code, json.loads(e.read()), time.perf_counter() - t0,
+                e.headers.get("X-DPT-Request-Id"))
 
 
 def _scrape(port: int, path: str):
@@ -155,15 +167,42 @@ def main() -> int:
 
     # -- stage A: floors + live scrape under concurrent load ----------
     port, mport = _free_port(), _free_port()
+    fport = _free_port()
     proc, log = _launch_server(rsl, ckpt, port, mport, queue=64,
                                tag="serve_a")
+    # the fleet collector rides along, scraping the replica's exporter
+    # on a tight interval so a merged cycle exists mid-load
+    fleet_log = open(os.path.join(work, "fleet_a.log"), "w")
+    fleet_proc = subprocess.Popen(
+        [sys.executable, "main.py", "fleet", "--rsl_path", rsl,
+         "--metrics-port", str(mport), "--ranks", "1",
+         "--fleet-port", str(fport), "--interval", "0.2",
+         "--stale-after", "5"],
+        cwd=REPO, env=_env(), stdout=fleet_log,
+        stderr=subprocess.STDOUT)
     try:
         _wait_live(port, proc)
-        status, body, _ = _post(port)   # functional round trip first
+        status, body, _, rid = _post(port)  # functional round trip first
         if status != 200 or not (0.0 < body.get("confidence", 0) <= 1.0):
             problems.append(f"A: warm request failed: {status} {body}")
+        if not (rid or "").startswith("r0-"):
+            problems.append(f"A: 200 answer missing X-DPT-Request-Id "
+                            f"(got {rid!r})")
+        # a fleet cycle must have seen the replica before load starts
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            try:
+                if json.loads(_scrape(fport, "/fleet")).get("alive"):
+                    break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.2)
+        else:
+            problems.append("A: fleet collector never reported the "
+                            "replica alive")
 
         results, mid_metrics, mid_health = [], [None], [None]
+        mid_fleet, mid_fleet_prom = [None], [None]
         lock = threading.Lock()
 
         def client():
@@ -180,6 +219,11 @@ def main() -> int:
                 mid_health[0] = json.loads(_scrape(mport, "/healthz"))
             except (OSError, ValueError) as e:
                 problems.append(f"A: mid-load scrape failed: {e}")
+            try:
+                mid_fleet[0] = json.loads(_scrape(fport, "/fleet"))
+                mid_fleet_prom[0] = _scrape(fport, "/metrics")
+            except (OSError, ValueError) as e:
+                problems.append(f"A: mid-load fleet scrape failed: {e}")
 
         threads = [threading.Thread(target=client)
                    for _ in range(LOAD_CLIENTS)]
@@ -195,12 +239,21 @@ def main() -> int:
         if len(results) != total:
             problems.append(f"A: {total - len(results)} of {total} "
                             f"requests never returned — hung clients")
-        bad = [(s, b) for s, b, _ in results if s != 200]
+        bad = [(s, b) for s, b, _, _ in results if s != 200]
         if bad:
             problems.append(f"A: {len(bad)} non-200 answers under "
                             f"in-bounds load, first: {bad[0]}")
+        # every 200 carries a unique request id the server minted
+        rids = [r for s, _, _, r in results if s == 200]
+        if any(not (r or "").startswith("r0-") for r in rids):
+            n = sum(1 for r in rids if not (r or "").startswith("r0-"))
+            problems.append(f"A: {n} of {len(rids)} 200s missing a "
+                            f"well-formed X-DPT-Request-Id header")
+        elif len(set(rids)) != len(rids):
+            problems.append(f"A: request ids not unique: "
+                            f"{len(rids) - len(set(rids))} duplicates")
         if results:
-            lats = sorted(dt * 1000.0 for _, _, dt in results)
+            lats = sorted(dt * 1000.0 for _, _, dt, _ in results)
             p50 = lats[len(lats) // 2]
             p95 = lats[min(len(lats) - 1, int(len(lats) * 0.95))]
             rps = len(results) / elapsed
@@ -225,8 +278,80 @@ def main() -> int:
                 health.get("serve", {}):
             problems.append(f"A: /healthz missing the serve extra "
                             f"(queue depth): {health}")
+
+        # fleet mid-load: merged series == sum of the per-replica
+        # scrapes from the SAME collector cycle (one replica here, so
+        # equality is exact — any drift means the merge mangled it)
+        doc = mid_fleet[0]
+        if not doc:
+            problems.append("A: no mid-load /fleet document")
+        else:
+            if doc.get("alive") != [0]:
+                problems.append(f"A: fleet alive {doc.get('alive')}, "
+                                f"expected [0]")
+            for series in ("dpt_serve_requests_total",
+                           "dpt_serve_batches_total"):
+                merged = doc.get("counters", {}).get(series)
+                per = sum(t["counters"].get(series, 0.0)
+                          for t in doc.get("targets", {}).values())
+                if merged is None or merged != per:
+                    problems.append(
+                        f"A: fleet merged {series}={merged} != sum of "
+                        f"per-replica scrapes {per} (same cycle)")
+                elif series == "dpt_serve_requests_total" and \
+                        merged < 1.0:
+                    problems.append(f"A: fleet merged {series} is "
+                                    f"{merged} mid-load — collector "
+                                    f"scraped nothing")
+            prom = mid_fleet_prom[0] or ""
+            if "dpt_serve_requests_total" not in prom or \
+                    not prom.endswith("dpt_up 1\n"):
+                problems.append("A: fleet /metrics re-export missing "
+                                "dpt_serve_* or dpt_up trailer")
     finally:
+        fleet_proc.terminate()
+        try:
+            fleet_proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            fleet_proc.kill()
+            fleet_proc.wait()
+        fleet_log.close()
         _stop_server(proc, log, problems, "A")
+
+    # trace reconciliation: snapshot trace-rank0.jsonl NOW, before
+    # stage B's fresh server appends to it with a restarted sequence
+    from distributedpytorch_tpu import tracing
+    records = [r for r in tracing.load_records(rsl)
+               if r.get("rank") == 0]
+    if len(records) < len(results):
+        problems.append(f"A: only {len(records)} trace records for "
+                        f"{len(results)} requests")
+    torn = tracing.reconcile(records)
+    if torn:
+        problems.append(f"A: {len(torn)} trace record(s) fail "
+                        f"reconciliation, first: {torn[0]}")
+    by_id = {r["id"]: r for r in records}
+    missing = [rid for _, _, _, rid in
+               [x for x in results if x[0] == 200]
+               if rid not in by_id]
+    if missing:
+        problems.append(f"A: {len(missing)} answered request id(s) "
+                        f"have no trace record, first: {missing[0]}")
+    # the server's span total can never exceed what the CLIENT timed
+    # (client adds connect + transfer); allow scheduling slack on this
+    # shared single-core host
+    over = [(rid, by_id[rid]["total_s"], dt)
+            for s, _, dt, rid in results
+            if s == 200 and rid in by_id
+            and by_id[rid]["total_s"] > dt + 0.25]
+    if over:
+        rid, srv, cli = over[0]
+        problems.append(f"A: {len(over)} trace total(s) exceed the "
+                        f"client-measured latency, first: {rid} "
+                        f"server {srv * 1000:.0f}ms vs client "
+                        f"{cli * 1000:.0f}ms")
+    print(f"serve gate A: {len(records)} trace records reconciled "
+          f"against client latencies")
 
     # -- stage B: saturation — shed counted, never hung ---------------
     port, mport = _free_port(), _free_port()
@@ -271,7 +396,7 @@ def main() -> int:
             problems.append("B: nothing answered under saturation — "
                             "shedding everything is an outage, not "
                             "backpressure")
-        for _, b, _ in shed:
+        for _, b, _, _ in shed:
             if b.get("queue_depth", 0) > 8:
                 problems.append(f"B: shed response reports queue depth "
                                 f"{b['queue_depth']} past the bound 8 "
@@ -279,7 +404,8 @@ def main() -> int:
                 break
         # shed answers must be immediate, not timed out: the slowest
         # shed stays far under the 0.25s/batch service time backlog
-        slow_shed = [dt for s, _, dt in results if s == 503 and dt > 5.0]
+        slow_shed = [dt for s, _, dt, _ in results
+                     if s == 503 and dt > 5.0]
         if slow_shed:
             problems.append(f"B: {len(slow_shed)} shed answer(s) took "
                             f">5s — 503s must be immediate")
@@ -300,8 +426,9 @@ def main() -> int:
     if problems:
         return 1
     print("serve gate OK: floors held under load, live dpt_serve_* "
-          "metrics scraped mid-run, saturation shed with 503s (counted, "
-          "never hung), SIGTERM drained clean")
+          "metrics scraped mid-run, traces reconciled + fleet merge "
+          "matched per-replica scrapes, saturation shed with 503s "
+          "(counted, never hung), SIGTERM drained clean")
     return 0
 
 

@@ -1,0 +1,286 @@
+"""The fleet collector (distributedpytorch_tpu/fleet.py, ISSUE 16).
+
+Prometheus text round trip (the per-rank exposition parses back into
+the exact sketch that produced it), merge semantics (counters sum,
+sketches fold, dpt_up is the collector's verdict), then the collector
+against fake rank exporters on ephemeral ports: scrape cycles, the
+/fleet + /metrics re-export, elastic age-out of a silent rank, and the
+SLO alerting path writing exactly one incident bundle per episode with
+the suspect rank and the offending request ids from trace records.
+"""
+
+import http.server
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from distributedpytorch_tpu import fleet, slo, telemetry
+
+# -- parsing + merging -------------------------------------------------
+
+
+def _sketch(values):
+    h = telemetry.Histogram("dpt_lat_ms")
+    for v in values:
+        h.observe(v)
+    return h
+
+
+def _rank_text(requests, failed, latencies, rank):
+    """One rank's /metrics body, in the exporter's exposition shape."""
+    merged = {
+        "counters": {"dpt_serve_requests_total": float(requests),
+                     "dpt_serve_failed_total": float(failed),
+                     'dpt_goodput_seconds_total{category="compute"}': 2.0},
+        "gauges": {"dpt_serve_queue_depth": 1.0},
+        "histograms": {"dpt_serve_request_latency_ms":
+                       _sketch(latencies)},
+    }
+    return fleet.render_fleet_metrics(merged, 1)
+
+
+def test_parse_metrics_roundtrips_the_sketch():
+    values = [1.5, 2.0, 10.0, 250.0, 0.0, -1.0]
+    text = _rank_text(10, 1, values, rank=0)
+    parsed = fleet.parse_metrics(text)
+    assert parsed["counters"]["dpt_serve_requests_total"] == 10.0
+    assert parsed["counters"][
+        'dpt_goodput_seconds_total{category="compute"}'] == 2.0
+    assert parsed["gauges"]["dpt_serve_queue_depth"] == 1.0
+    st = parsed["histograms"]["dpt_serve_request_latency_ms"]
+    src = _sketch(values)
+    assert st["count"] == src.count and st["nonpos"] == src._nonpos
+    assert {int(k): v for k, v in st["buckets"].items()} == src._buckets
+    assert st["min"] == src.min and st["max"] == src.max
+
+
+def test_merge_targets_sums_counters_and_folds_sketches():
+    import random
+    rng = random.Random(3)
+    va = [rng.lognormvariate(3.0, 1.0) for _ in range(2000)]
+    vb = [rng.lognormvariate(4.0, 0.5) for _ in range(1000)]
+    pa = fleet.parse_metrics(_rank_text(100, 5, va, 0))
+    pb = fleet.parse_metrics(_rank_text(50, 0, vb, 1))
+    merged = fleet.merge_targets([pa, pb])
+    assert merged["counters"]["dpt_serve_requests_total"] == 150.0
+    assert merged["counters"]["dpt_serve_failed_total"] == 5.0
+    h = merged["histograms"]["dpt_serve_request_latency_ms"]
+    pooled = _sketch(va + vb)
+    assert h.count == pooled.count
+    for q in (0.5, 0.95, 0.99):
+        assert h.quantile(q) == pytest.approx(pooled.quantile(q),
+                                              rel=1e-9)
+
+
+def test_fleet_render_reports_alive_count_not_self_reports():
+    text = fleet.render_fleet_metrics(
+        {"counters": {}, "gauges": {"dpt_up": 1.0}, "histograms": {}}, 3)
+    assert text.endswith("dpt_up 3\n")
+    # per-rank dpt_up self-reports never leak into the merged gauges
+    merged = fleet.merge_targets([{"gauges": {"dpt_up": 1.0}}])
+    assert "dpt_up" not in merged["gauges"]
+
+
+# -- fake rank exporters ------------------------------------------------
+
+class _FakeExporter:
+    """A stand-in rank: serves a mutable /metrics body + /healthz."""
+
+    def __init__(self, rank):
+        self.rank = rank
+        self.requests = 0.0
+        self.failed = 0.0
+        self.latencies = [5.0]
+        outer = self
+
+        class _H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - http.server API
+                if self.path.startswith("/metrics"):
+                    body = _rank_text(outer.requests, outer.failed,
+                                      outer.latencies,
+                                      outer.rank).encode()
+                elif self.path.startswith("/healthz"):
+                    body = json.dumps({"status": "ok",
+                                       "rank": outer.rank}).encode()
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                      _H)
+        self.port = self.server.server_address[1]
+        self.server.daemon_threads = True
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture
+def two_ranks():
+    exps = [_FakeExporter(0), _FakeExporter(1)]
+    yield exps
+    for e in exps:
+        e.close()
+
+
+def _collector(tmp_path, exps, **kw):
+    """A collector aimed at fake exporters.  The fakes sit on arbitrary
+    ephemeral ports, so the base+rank port convention is patched per
+    target after construction."""
+    args = dict(rsl_path=str(tmp_path), ranks=len(exps), metrics_port=0,
+                interval_s=0.05, stale_after=2, port=0, max_cycles=0)
+    args.update(kw)
+    coll = fleet.FleetCollector(**args)
+    for t, e in zip(coll._targets, exps):
+        t.port = e.port
+    return coll
+
+
+def test_collector_scrapes_merges_persists_and_reexports(tmp_path,
+                                                         two_ranks):
+    two_ranks[0].requests = 30.0
+    two_ranks[1].requests = 12.0
+    coll = _collector(tmp_path, two_ranks, max_cycles=2)
+    coll.start()
+    try:
+        coll.run()
+        # merged == sum of per-rank scrapes, same cycle
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{coll.port}/fleet", timeout=5) as r:
+            doc = json.loads(r.read())
+        assert doc["alive"] == [0, 1]
+        assert doc["counters"]["dpt_serve_requests_total"] == 42.0
+        per_rank = sum(
+            t["counters"]["dpt_serve_requests_total"]
+            for t in doc["targets"].values())
+        assert per_rank == doc["counters"]["dpt_serve_requests_total"]
+        assert doc["targets"]["0"]["health"]["status"] == "ok"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{coll.port}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert "dpt_serve_requests_total 42" in text
+        assert text.endswith("dpt_up 2\n")
+    finally:
+        coll.close()
+    lines = [json.loads(ln) for ln in
+             (tmp_path / "fleet-metrics.jsonl").read_text().splitlines()]
+    assert [s["cycle"] for s in lines] == [1, 2]
+    assert all(s["kind"] == "fleet_sample" for s in lines)
+
+
+def test_collector_ages_out_dead_rank_and_sees_joiner(tmp_path,
+                                                      two_ranks):
+    coll = _collector(tmp_path, two_ranks, stale_after=2)
+    try:
+        coll.scrape_once()
+        assert coll._samples[-1]["alive"] == [0, 1]
+        two_ranks[1].close()  # the rank dies
+        coll.scrape_once()    # failure 1: still within grace
+        assert coll._samples[-1]["alive"] == [0, 1]
+        coll.scrape_once()    # failure 2 == stale_after: aged out
+        sample = coll._samples[-1]
+        assert sample["alive"] == [0]
+        assert "1" not in sample["targets"]
+        # no stale dpt_up: the re-export counts ONE alive rank
+        assert fleet.render_fleet_metrics(
+            {"counters": sample["counters"], "gauges": sample["gauges"],
+             "histograms": {}},
+            len(sample["alive"])).endswith("dpt_up 1\n")
+        # a joiner on the same port re-appears within one cycle
+        joiner = _FakeExporter(1)
+        coll._targets[1].port = joiner.port
+        try:
+            coll.scrape_once()
+            assert coll._samples[-1]["alive"] == [0, 1]
+        finally:
+            joiner.close()
+    finally:
+        coll.close()
+
+
+ERROR_SLO = {"name": "serve-errors", "kind": "ratio",
+             "bad": "dpt_serve_failed_total",
+             "total": "dpt_serve_requests_total",
+             "target": 0.99,
+             "windows": [{"seconds": 0.2, "burn": 2.0},
+                         {"seconds": 0.6, "burn": 1.0}]}
+
+
+def test_collector_fires_exactly_one_incident_per_episode(tmp_path,
+                                                          two_ranks):
+    # offending trace records: rank 1 failed two requests "now"
+    now = time.time()
+    with open(tmp_path / "trace-rank1.jsonl", "w") as f:
+        for seq, outcome in ((4, "failed"), (5, "failed"),
+                             (6, "answered")):
+            f.write(json.dumps({
+                "kind": "request", "id": "r1-%06d" % seq, "seq": seq,
+                "rank": 1, "status": 500 if outcome == "failed" else 200,
+                "outcome": outcome, "spans": {}, "total_s": 0.0,
+                "ts": now, "mono": 0.0, "ts_admit": now,
+                "mono_admit": 0.0}) + "\n")
+    slos = slo.validate_spec({"slos": [ERROR_SLO]})
+    coll = _collector(tmp_path, two_ranks, slos=slos, interval_s=0.1)
+    try:
+        coll.scrape_once()
+        time.sleep(0.1)
+        coll.scrape_once()  # clean baseline: nothing fires
+        assert coll.incidents_written == 0
+        # rank 1 starts failing hard
+        two_ranks[0].requests = 100.0
+        two_ranks[1].requests = 100.0
+        two_ranks[1].failed = 50.0
+        for _ in range(8):
+            time.sleep(0.1)
+            coll.scrape_once()
+        assert coll.incidents_written == 1  # one bundle per episode
+        bundles = slo.load_incidents(str(tmp_path))
+        assert len(bundles) == 1
+        b = bundles[0]
+        assert b["slo"] == "serve-errors"
+        assert b["suspect_ranks"] == [1]
+        assert "r1-000004" in b["offending_requests"]
+        assert "r1-000006" not in b["offending_requests"]  # answered
+        assert b["healthz"]["1"]["status"] == "ok"
+        # recovery clears, a second burst is a NEW episode
+        two_ranks[1].failed = 50.0  # frozen: error rate decays to 0
+        for _ in range(10):
+            time.sleep(0.1)
+            two_ranks[0].requests += 30
+            two_ranks[1].requests += 30
+            coll.scrape_once()
+        assert "serve-errors" not in coll._firing
+        two_ranks[1].failed = 200.0
+        two_ranks[1].requests += 100
+        time.sleep(0.1)
+        coll.scrape_once()
+        assert coll.incidents_written == 2
+    finally:
+        coll.close()
+
+
+def test_run_cli_validation_error_is_a_clean_exit(tmp_path, capsys):
+    from distributedpytorch_tpu.config import Config
+
+    bad = tmp_path / "slo.json"
+    bad.write_text(json.dumps({"slos": [{"name": "x"}]}))
+    cfg = Config(action="fleet", rsl_path=str(tmp_path),
+                 metrics_port=1, fleet_ranks=1, fleet_port=0,
+                 fleet_interval=0.05, fleet_stale_after=1,
+                 fleet_max_cycles=1, slo_spec=str(bad))
+    assert fleet.run_cli(cfg) == 2
+    out = capsys.readouterr().out
+    assert "kind" in out and "slo.json" in out

@@ -1088,6 +1088,89 @@ def test_serving_package_path_is_in_scope(tmp_path):
             if f.rule == "unbounded-queue-in-server"]
 
 
+# -- rule 16: unbounded-metric-cardinality -----------------------------
+
+_METRIC_FSTRING_BAD = """
+    def record(tel, request_id, latency_ms):
+        tel.counter(f"serve/errors/{request_id}").add()
+        tel.histogram(f"latency/{request_id}").observe(latency_ms)
+"""
+
+_METRIC_PERCENT_BAD = """
+    def record(tel, rank):
+        tel.gauge("fleet/up_rank_%d" % rank).set(1.0)
+"""
+
+_METRIC_FORMAT_BAD = """
+    def record(tel, path):
+        tel.counter("io/{}".format(path)).add()
+"""
+
+_METRIC_CONCAT_BAD = """
+    def record(tel, host):
+        tel.counter("scrape/" + host).add()
+"""
+
+_METRIC_GOOD = """
+    def record(tel, request_id, latency_ms):
+        # identity goes in attrs / labels, the series name stays fixed
+        tel.counter("serve/errors").add()
+        tel.histogram("serve/request_latency_ms").observe(latency_ms)
+        tel.gauge("fleet/alive").set(2.0)
+        tel.counter("serve/" + "shed").add()     # literal concat: fine
+        name = "serve/requests"
+        tel.counter(name).add()                  # resolved elsewhere
+"""
+
+
+def test_metric_cardinality_fstring_positive(tmp_path):
+    found = _lint(tmp_path, {"telemetry.py": _METRIC_FSTRING_BAD},
+                  rule="unbounded-metric-cardinality")
+    assert len(found) == 2
+    assert "series" in found[0].message
+
+
+def test_metric_cardinality_percent_and_format_positive(tmp_path):
+    assert _lint(tmp_path, {"fleet.py": _METRIC_PERCENT_BAD},
+                 rule="unbounded-metric-cardinality")
+    assert _lint(tmp_path, {"goodput.py": _METRIC_FORMAT_BAD},
+                 rule="unbounded-metric-cardinality")
+    assert _lint(tmp_path, {"slo.py": _METRIC_CONCAT_BAD},
+                 rule="unbounded-metric-cardinality")
+
+
+def test_metric_cardinality_static_names_negative(tmp_path):
+    assert _lint(tmp_path, {"telemetry.py": _METRIC_GOOD},
+                 rule="unbounded-metric-cardinality") == []
+
+
+def test_metric_cardinality_serving_package_in_scope(tmp_path):
+    pkg = tmp_path / "serving"
+    pkg.mkdir()
+    (pkg / "server.py").write_text(textwrap.dedent(_METRIC_FSTRING_BAD))
+    findings, _ = lint_paths([str(tmp_path)], root=str(tmp_path))
+    assert [f for f in findings
+            if f.rule == "unbounded-metric-cardinality"]
+
+
+def test_metric_cardinality_non_telemetry_module_negative(tmp_path):
+    assert _lint(tmp_path, {"engine.py": _METRIC_FSTRING_BAD},
+                 rule="unbounded-metric-cardinality") == []
+
+
+def test_metric_cardinality_rationale_comment_silences(tmp_path):
+    src = """
+        _PHASES = ("train", "eval")
+
+        def record(tel, phase):
+            # phase is drawn from the fixed _PHASES enum above: the
+            # series set is bounded by construction
+            tel.counter(f"step/{phase}").add()
+    """
+    assert _lint(tmp_path, {"telemetry.py": src},
+                 rule="unbounded-metric-cardinality") == []
+
+
 # -- CLI contract ------------------------------------------------------
 
 def test_repo_lints_clean_via_run_cli(capsys):

@@ -1,0 +1,243 @@
+"""The pure SLO evaluator (distributedpytorch_tpu/slo.py, ISSUE 16).
+
+Everything here runs on hand-built sample windows — no sockets, no
+processes, and no clocks: the evaluator's only notion of time is the
+``t`` each sample carries, which is exactly what lets the fleet
+simulator and the autoscaler consume it unchanged.  Burn-rate window
+math (fast burn fires, slow burn holds, recovery clears), windowed
+quantiles from delta sketches, one-line spec validation, determinism,
+and graftlint rule 13 staying clean on the module itself.
+"""
+
+import json
+import os
+
+import pytest
+
+from distributedpytorch_tpu import slo, telemetry
+
+# -- helpers -----------------------------------------------------------
+
+ERROR_SLO = {
+    "name": "serve-errors", "kind": "ratio",
+    "bad": "dpt_serve_failed_total",
+    "total": "dpt_serve_requests_total",
+    "target": 0.99,
+    # fast window: 10s at 2x burn; slow window: 60s at 1x — both must
+    # exceed for the objective to fire (multi-window burn rate).
+    "windows": [{"seconds": 10, "burn": 2.0},
+                {"seconds": 60, "burn": 1.0}],
+}
+
+
+def _sample(t, bad=0.0, total=0.0, extra=None, hists=None):
+    counters = {"dpt_serve_failed_total": bad,
+                "dpt_serve_requests_total": total}
+    counters.update(extra or {})
+    return {"t": float(t), "counters": counters,
+            "histograms": hists or {}}
+
+
+def _hist_state(values):
+    h = telemetry.Histogram("x")
+    for v in values:
+        h.observe(v)
+    return {"count": h.count, "sum": h.sum, "min": h.min, "max": h.max,
+            "nonpos": h._nonpos, "buckets": dict(h._buckets)}
+
+
+# -- spec validation ---------------------------------------------------
+
+def test_validate_spec_accepts_the_worked_example():
+    slos = slo.validate_spec({"slos": [ERROR_SLO]})
+    assert slos[0]["name"] == "serve-errors"
+
+
+@pytest.mark.parametrize("mutate,expect", [
+    (lambda s: s.pop("name"), "name"),
+    (lambda s: s.update(name="bad name!"), "A-Za-z0-9"),
+    (lambda s: s.update(kind="nope"), "kind"),
+    (lambda s: s.update(windows=[]), "windows"),
+    (lambda s: s.update(windows=[{"seconds": -1}]), "seconds"),
+    (lambda s: s.update(windows=[{"seconds": 5}]), "burn"),
+    (lambda s: s.pop("bad"), "'bad'"),
+    (lambda s: s.update(target=1.5), "target"),
+])
+def test_validate_spec_errors_are_one_actionable_line(mutate, expect):
+    spec = json.loads(json.dumps(ERROR_SLO))
+    mutate(spec)
+    with pytest.raises(ValueError) as e:
+        slo.validate_spec({"slos": [spec]})
+    msg = str(e.value)
+    assert expect in msg and "\n" not in msg
+    assert "serve-errors" in msg or "slos[0]" in msg
+
+
+def test_validate_spec_rejects_duplicates_and_empty():
+    with pytest.raises(ValueError, match="duplicate"):
+        slo.validate_spec({"slos": [ERROR_SLO, ERROR_SLO]})
+    with pytest.raises(ValueError, match="empty"):
+        slo.validate_spec({"slos": []})
+    with pytest.raises(ValueError, match="'slos'"):
+        slo.validate_spec(["not", "an", "object"])
+
+
+def test_load_spec_names_the_file(tmp_path):
+    p = tmp_path / "slo.json"
+    p.write_text("{ not json")
+    with pytest.raises(ValueError, match="slo.json"):
+        slo.load_spec(str(p))
+    with pytest.raises(ValueError, match="cannot read"):
+        slo.load_spec(str(tmp_path / "absent.json"))
+    p.write_text(json.dumps({"slos": [ERROR_SLO]}))
+    assert slo.load_spec(str(p))[0]["kind"] == "ratio"
+
+
+# -- burn-rate window math ---------------------------------------------
+
+def test_fast_burn_fires():
+    """A sustained 10% error rate against a 99% target burns at 10x:
+    both windows exceed and the objective fires."""
+    slos = slo.validate_spec({"slos": [ERROR_SLO]})
+    samples = [_sample(t, bad=10.0 * i, total=100.0 * i)
+               for i, t in enumerate(range(0, 70, 5))]
+    (v,) = slo.evaluate(slos, samples)
+    assert v["firing"]
+    assert all(w["exceeded"] for w in v["windows"])
+    assert v["windows"][0]["value"] == pytest.approx(10.0)
+
+
+def test_slow_burn_holds():
+    """An old error burst outside the fast window must NOT fire: the
+    long window still remembers it, the short window has recovered —
+    the multi-window AND is what stops the stale page."""
+    slos = slo.validate_spec({"slos": [ERROR_SLO]})
+    samples = [_sample(0, bad=0, total=0),
+               _sample(5, bad=30, total=100),    # the burst
+               _sample(30, bad=30, total=500),
+               _sample(55, bad=30, total=900),
+               _sample(60, bad=30, total=1000)]  # clean since t=5
+    (v,) = slo.evaluate(slos, samples)
+    assert not v["firing"]
+    fast, slow = v["windows"]
+    assert slow["exceeded"] and not fast["exceeded"]
+
+
+def test_recovery_clears():
+    slos = slo.validate_spec({"slos": [ERROR_SLO]})
+    burning = [_sample(t, bad=5.0 * i, total=50.0 * i)
+               for i, t in enumerate(range(0, 70, 5))]
+    assert slo.evaluate(slos, burning)[0]["firing"]
+    # 120 clean seconds later both windows see zero new errors
+    last = burning[-1]
+    bad = last["counters"]["dpt_serve_failed_total"]
+    tot = last["counters"]["dpt_serve_requests_total"]
+    recovered = burning + [
+        _sample(last["t"] + dt, bad=bad, total=tot + 10.0 * dt)
+        for dt in range(5, 125, 5)]
+    assert not slo.evaluate(slos, recovered)[0]["firing"]
+
+
+def test_no_traffic_and_short_series_do_not_fire():
+    slos = slo.validate_spec({"slos": [ERROR_SLO]})
+    assert not slo.evaluate(slos, [])[0]["firing"]
+    assert not slo.evaluate(slos, [_sample(0, 5, 10)])[0]["firing"]
+    idle = [_sample(t, bad=7.0, total=7.0) for t in range(0, 70, 5)]
+    assert not slo.evaluate(slos, idle)[0]["firing"]  # no deltas
+
+
+def test_determinism_same_window_same_verdicts():
+    slos = slo.validate_spec({"slos": [ERROR_SLO]})
+    samples = [_sample(t, bad=2.0 * i, total=40.0 * i)
+               for i, t in enumerate(range(0, 70, 5))]
+    a = slo.evaluate(slos, samples)
+    b = slo.evaluate(slos, json.loads(json.dumps(samples)))
+    assert a == b
+
+
+# -- quantile + share objectives ---------------------------------------
+
+def test_quantile_objective_uses_windowed_delta_sketch():
+    spec = {"slos": [{"name": "p95", "kind": "quantile",
+                      "series": "dpt_serve_request_latency_ms",
+                      "q": 0.95, "max": 100.0,
+                      "windows": [{"seconds": 10}]}]}
+    slos = slo.validate_spec(spec)
+    slow_then_fast = [
+        _sample(0, hists={"dpt_serve_request_latency_ms":
+                          _hist_state([500.0] * 100)}),
+        _sample(20, hists={"dpt_serve_request_latency_ms":
+                           _hist_state([500.0] * 100 + [10.0] * 100)}),
+    ]
+    (v,) = slo.evaluate(slos, slow_then_fast)
+    # lifetime p95 is ~500ms, but the WINDOW only saw the 10ms tail:
+    # the startup spike must not page forever
+    assert not v["firing"]
+    assert v["windows"][0]["value"] == pytest.approx(10.0, rel=0.05)
+    fast_then_slow = [
+        _sample(0, hists={"dpt_serve_request_latency_ms":
+                          _hist_state([10.0] * 100)}),
+        _sample(20, hists={"dpt_serve_request_latency_ms":
+                           _hist_state([10.0] * 100 + [500.0] * 100)}),
+    ]
+    (v2,) = slo.evaluate(slos, fast_then_slow)
+    assert v2["firing"]
+    assert v2["windows"][0]["value"] == pytest.approx(500.0, rel=0.05)
+
+
+def test_share_objective_over_goodput_categories():
+    spec = {"slos": [{"name": "compute-share", "kind": "share",
+                      "category": "compute", "min": 0.5,
+                      "windows": [{"seconds": 30}]}]}
+    slos = slo.validate_spec(spec)
+
+    def gp(compute, other):
+        return {'dpt_goodput_seconds_total{category="compute"}': compute,
+                'dpt_goodput_seconds_total{category="input_wait"}': other}
+
+    healthy = [_sample(0, extra=gp(0, 0)), _sample(35, extra=gp(30, 5))]
+    (v,) = slo.evaluate(slos, healthy)
+    assert not v["firing"]
+    starved = [_sample(0, extra=gp(0, 0)), _sample(35, extra=gp(5, 30))]
+    (v2,) = slo.evaluate(slos, starved)
+    assert v2["firing"]
+    assert v2["windows"][0]["value"] == pytest.approx(5 / 35, rel=0.01)
+
+
+# -- incidents report --------------------------------------------------
+
+def test_incidents_report_empty_and_with_bundles(tmp_path):
+    text = slo.incidents_report(str(tmp_path))
+    assert "no incidents" in text
+    bundle = {"kind": "incident", "slo": "serve-errors",
+              "slo_kind": "ratio", "cycle": 7,
+              "windows": [{"seconds": 10, "value": 12.0,
+                           "threshold": 2.0, "t_start": 1.0,
+                           "t_end": 11.0}],
+              "suspect_ranks": [1],
+              "offending_requests": ["r1-000004", "r1-000005"],
+              "healthz": {"0": {"status": "ok"}, "1": None}}
+    (tmp_path / "incident-001-serve-errors.json").write_text(
+        json.dumps(bundle))
+    text = slo.incidents_report(str(tmp_path))
+    assert "serve-errors" in text and "r1-000004" in text
+    assert "suspect ranks: [1]" in text
+    assert "(down)" in text  # rank 1's healthz was unreachable
+    assert len(slo.load_incidents(str(tmp_path))) == 1
+
+
+# -- purity is enforced, not aspirational ------------------------------
+
+def test_slo_module_is_clock_free_under_graftlint_rule_13():
+    from distributedpytorch_tpu.analysis.core import lint_paths
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "distributedpytorch_tpu", "slo.py")
+    findings, _ = lint_paths([path], root=repo)
+    clock = [f for f in findings
+             if f.rule == "wall-clock-in-measurement"]
+    assert clock == []
+    # stronger than the lint rule: the module never imports time at all
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    assert "import time" not in src

@@ -61,6 +61,56 @@ def test_counter_gauge_histogram_semantics(tmp_path):
                for e in events)
 
 
+def test_histogram_merge_matches_pooled_within_sketch_error():
+    """The fleet collector's core primitive (ISSUE 16): folding
+    per-rank sketches must agree with one sketch that observed every
+    value directly, and both must sit within the sketch's ~2% bound of
+    the TRUE pooled quantiles — across disjoint distributions (ranks
+    rarely see identical traffic), empty ranks, and non-positive
+    observations."""
+    import math
+    import random
+
+    rng = random.Random(1234)
+    shards = [
+        [rng.lognormvariate(2.0, 0.8) for _ in range(4000)],   # fast rank
+        [rng.lognormvariate(4.5, 0.4) for _ in range(2500)],   # slow rank
+        [rng.uniform(0.5, 900.0) for _ in range(1500)],        # noisy rank
+        [],                                                    # idle rank
+        [0.0, -3.0] + [rng.expovariate(0.01) for _ in range(500)],
+    ]
+    merged = telemetry.Histogram("lat")
+    pooled = telemetry.Histogram("lat")
+    for shard in shards:
+        h = telemetry.Histogram("lat")
+        for v in shard:
+            h.observe(v)
+            pooled.observe(v)
+        merged.merge(h)
+    values = sorted(v for shard in shards for v in shard)
+    assert merged.count == pooled.count == len(values)
+    assert merged.sum == pytest.approx(pooled.sum)
+    assert (merged.min, merged.max) == (pooled.min, pooled.max)
+    for q in (0.5, 0.9, 0.95, 0.99):
+        exact = values[min(len(values) - 1, int(q * len(values)))]
+        # bucket-wise merge is exact: merged == pooled to fp precision
+        assert merged.quantile(q) == pytest.approx(pooled.quantile(q),
+                                                   rel=1e-12)
+        # and the sketch itself stays inside its ±2% representative
+        # error of the true pooled quantile
+        assert merged.quantile(q) == pytest.approx(exact, rel=0.02)
+    # merging into an empty sketch is identity
+    fresh = telemetry.Histogram("lat").merge(pooled)
+    assert fresh.quantile(0.95) == pooled.quantile(0.95)
+    # from_parts round trip (the fleet's reconstruct-then-merge path)
+    rebuilt = telemetry.Histogram.from_parts(
+        "lat", pooled.count, pooled.sum, pooled.min, pooled.max,
+        dict(pooled._buckets), nonpos=pooled._nonpos)
+    assert rebuilt.quantile(0.99) == pooled.quantile(0.99)
+    assert math.isinf(telemetry.Histogram.from_parts(
+        "lat", 0, 0.0, 0.0, 0.0, {}).min)
+
+
 def test_disabled_instance_does_no_file_io(tmp_path):
     tel = telemetry.Telemetry(enabled=False, rsl_path=str(tmp_path))
     tel.counter("c").add()

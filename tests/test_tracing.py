@@ -1,0 +1,216 @@
+"""Request tracing (distributedpytorch_tpu/tracing.py, ISSUE 16).
+
+The span-chain contract first as pure units (sum(spans) == total_s by
+construction, terminal spans for shed/timeout, exactly-once records),
+then the wired tier: an in-process ServingTier with a stub infer_fn and
+a live tracer must hand every client an ``X-DPT-Request-Id`` header,
+land one reconciling record per request in trace-rank<N>.jsonl, and
+give timeline a per-request track.  The full CLI path (main.py serve
+with tracing always-on) is the serve gate's job.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu import tracing
+from distributedpytorch_tpu.serving import ServingTier
+
+SHAPE = (4, 4)
+
+
+@pytest.fixture
+def tracer(tmp_path):
+    """A live tracer writing under tmp_path, restored to the disabled
+    default afterward so other tests see the zero-cost path."""
+    t = tracing.configure(str(tmp_path), True, rank=0)
+    yield t
+    tracing.configure(".", False)
+
+
+def _stub_infer(arr):
+    return (arr.reshape(arr.shape[0], -1).max(axis=1).astype(np.int32),
+            np.full((arr.shape[0],), 0.5, np.float64))
+
+
+def _make_tier(**kw):
+    args = dict(infer_fn=_stub_infer, sample_shape=SHAPE,
+                sample_dtype=np.uint8, buckets=(1, 4), max_queue=8,
+                max_latency_s=0.01, port=0, request_timeout_s=5.0)
+    args.update(kw)
+    return ServingTier(**args)
+
+
+def _post(port, image, timeout=5.0):
+    """(status, body, headers) — the traced variant of the round trip."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps({"image": image}).encode())
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+# -- the span chain as a unit ------------------------------------------
+
+def test_span_chain_sums_to_total_and_ids_are_deterministic(tracer):
+    t1 = tracer.start()
+    t2 = tracer.start()
+    assert (t1.id, t2.id) == ("r0-000001", "r0-000002")
+    t1.mark_admitted()
+    time.sleep(0.002)
+    t1.mark_dequeued()
+    t1.mark_infer_start(4)
+    t1.mark_infer_end()
+    t1.note_latency(2.5)
+    t1.finish(200, "answered")
+    t2.finish(503, "shed", queue_depth=8)
+    recs = tracing.load_records(tracer.path.rsplit("/", 1)[0])
+    assert [r["id"] for r in recs] == ["r0-000001", "r0-000002"]
+    answered, shed = recs
+    assert set(answered["spans"]) == {"queue_wait", "batch_form",
+                                      "infer", "respond"}
+    assert answered["spans"]["queue_wait"] >= 0.002
+    assert answered["bucket"] == 4 and answered["latency_ms"] == 2.5
+    assert shed["outcome"] == "shed" and "shed" in shed["spans"]
+    assert shed["attrs"]["queue_depth"] == 8
+    assert tracing.reconcile(recs) == []
+
+
+def test_reconcile_flags_torn_chain_and_latency_mismatch(tracer):
+    t = tracer.start()
+    t.mark_admitted()
+    t.mark_dequeued()
+    t.mark_infer_start(1)
+    t.mark_infer_end()
+    t.note_latency(5000.0)  # nothing slept 5s: must not reconcile
+    t.finish(200, "answered")
+    recs = tracing.load_records(str(tracer.path.rsplit("/", 1)[0]))
+    problems = tracing.reconcile(recs)
+    assert len(problems) == 1 and "latency_ms" in problems[0]
+    torn = dict(recs[0], total_s=recs[0]["total_s"] + 1.0)
+    assert any("torn" in p for p in tracing.reconcile([torn]))
+
+
+def test_finish_writes_exactly_once(tracer):
+    """The 504-then-late-complete race: the handler's timeout record
+    wins and the driver's later finish is a no-op."""
+    t = tracer.start()
+    t.finish(504, "timeout")
+    t.finish(200, "answered")
+    recs = tracing.load_records(str(tracer.path.rsplit("/", 1)[0]))
+    assert len(recs) == 1 and recs[0]["outcome"] == "timeout"
+
+
+def test_disabled_tracer_is_free_and_sink_failure_degrades(tmp_path):
+    assert tracing.Tracer(enabled=False).start() is None
+    bad = tracing.Tracer(enabled=True,
+                         rsl_path=str(tmp_path / "file-not-dir"))
+    (tmp_path / "file-not-dir").write_text("occupied")
+    t = bad.start()
+    t.finish(200, "answered")  # must not raise
+    assert bad.write_errors == 1
+    t2 = bad.start()
+    assert t2 is not None  # still serving, just not recording
+
+
+def test_rank_of_id():
+    assert tracing.rank_of_id("r1-000007") == 1
+    assert tracing.rank_of_id("garbage") is None
+    assert tracing.rank_of_id("") is None
+
+
+# -- wired through the tier --------------------------------------------
+
+def test_tier_returns_request_id_header_and_reconciling_records(
+        tmp_path, tracer):
+    tier = _make_tier()
+    tier.start()
+    driver = threading.Thread(target=tier.run, daemon=True)
+    driver.start()
+    try:
+        img = np.full(SHAPE, 7, np.uint8).tolist()
+        ids = []
+        for _ in range(3):
+            status, body, headers = _post(tier.port, img)
+            assert status == 200
+            assert headers["X-DPT-Request-Id"].startswith("r0-")
+            ids.append(headers["X-DPT-Request-Id"])
+        assert len(set(ids)) == 3
+    finally:
+        tier.close()
+        driver.join(timeout=5)
+    recs = tracing.load_records(str(tmp_path))
+    answered = [r for r in recs if r["outcome"] == "answered"]
+    assert sorted(r["id"] for r in answered) == sorted(ids)
+    assert tracing.reconcile(recs) == []
+    for r in answered:
+        assert set(r["spans"]) == {"queue_wait", "batch_form", "infer",
+                                   "respond"}
+
+
+def test_tier_shed_path_gets_terminal_span_and_header(tmp_path, tracer):
+    tier = _make_tier(max_queue=1)
+    tier.start()  # driver deliberately absent: the queue fills
+    try:
+        img = np.zeros(SHAPE, np.uint8).tolist()
+        results = []
+        threads = [threading.Thread(
+            target=lambda: results.append(_post(tier.port, img, 5.0)))
+            for _ in range(3)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5.0
+        while len(results) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(results) >= 2
+        for status, body, headers in results:
+            assert status == 503
+            assert headers["X-DPT-Request-Id"].startswith("r0-")
+    finally:
+        tier.close()
+    shed = [r for r in tracing.load_records(str(tmp_path))
+            if r["outcome"] == "shed"]
+    assert len(shed) >= 2
+    for r in shed:
+        assert "shed" in r["spans"] and r["status"] == 503
+        assert r["attrs"]["queue_depth"] >= 1
+
+
+def test_timeline_gains_request_track(tmp_path, tracer):
+    from distributedpytorch_tpu import timeline
+
+    t = tracer.start()
+    t.mark_admitted()
+    t.mark_dequeued()
+    t.mark_infer_start(1)
+    t.mark_infer_end()
+    t.note_latency(0.1)
+    t.finish(200, "answered")
+    tel_dir = tmp_path / "telemetry"
+    tel_dir.mkdir()
+    (tel_dir / "rank0.jsonl").write_text(json.dumps({
+        "kind": "event", "name": "run_start", "rank": 0,
+        "ts": time.time(), "mono": time.monotonic()}) + "\n")
+    result = timeline.build_timeline(str(tmp_path))
+    reqs = [e for e in result["trace"]["traceEvents"]
+            if e.get("cat") == "request"]
+    assert [e["name"] for e in reqs] == ["queue_wait", "batch_form",
+                                         "infer", "respond"]
+    assert all(e["tid"] == timeline._TID_REQUESTS for e in reqs)
+    assert reqs[0]["args"]["id"] == "r0-000001"
+    # the chain property makes the slices tile: each starts where the
+    # previous ended
+    for a, b in zip(reqs, reqs[1:]):
+        assert b["ts"] == pytest.approx(a["ts"] + a["dur"], abs=1.0)
+    names = [e["args"]["name"] for e in result["trace"]["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"
+             and e["pid"] == 0]
+    assert "requests" in names
