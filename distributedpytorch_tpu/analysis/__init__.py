@@ -9,16 +9,32 @@ state — are invisible to pytest but mechanically detectable.  This
 package is the detector:
 
   * :mod:`core` — findings, the ``# graftlint:`` pragma grammar,
-    project loading, human/JSON reports;
-  * :mod:`rules` — the rule catalog (see ``rules.RULES``);
+    project loading, the per-module :class:`~core.ModuleIndex` (one
+    cached AST traversal shared by every rule), human/JSON reports;
+  * :mod:`wholeprogram` — the whole-program core: repo-wide symbol
+    table (import aliases, classes, factory return types, annotated
+    globals), resolved call graph with transitive closure, lock
+    inventory, and signal-handler registry.  Built once per project,
+    memoized on :meth:`~core.Project.whole_program`; only the
+    interprocedural rules (17-19) trigger the build;
+  * :mod:`rules` — the rule catalog (see ``rules.RULES``): 16 per-file
+    rules plus three interprocedural ones — collective-divergence
+    (SPMD collectives under rank-dependent control flow),
+    lock-order-cycle (acquisition cycles + signal handlers reaching
+    non-reentrant locks), mesh-axis-propagation (axis names flowing
+    through call chains into collectives);
   * :mod:`transfer_guard` — the runtime sanitizer leg: a 1-epoch CPU
     smoke under ``jax.transfer_guard`` that catches silent device->host
     transfers the static pass cannot see.
 
 Entry points: ``python main.py lint`` and ``scripts/graftlint.py``
-(static pass, exit 0 = clean), ``scripts/graftlint.py --smoke``
-(sanitizer).  Both gate in ``scripts/gate.sh``.
+(static pass, exit 0 = clean), ``--changed-only`` to report findings
+only in git-changed files (the whole program is still loaded so the
+interprocedural rules stay sound — whole-repo remains the gate
+default), ``scripts/graftlint.py --smoke`` (sanitizer).  All gate in
+``scripts/gate.sh``.
 """
 
 from .core import Finding, Project, lint_paths, render_findings  # noqa: F401
 from .rules import RULES  # noqa: F401
+from .wholeprogram import WholeProgram  # noqa: F401

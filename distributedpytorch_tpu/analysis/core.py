@@ -26,8 +26,91 @@ import io
 import json
 import os
 import re
+import subprocess
 import tokenize
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+
+# -- shared AST helpers (used by rules.py and wholeprogram.py) ---------
+
+def dotted(node: ast.AST) -> str:
+    """Best-effort dotted name for a call target / reference:
+    ``jax.lax.psum`` -> "jax.lax.psum", ``self._apply`` -> "self._apply",
+    anything unresolvable -> ""."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif not parts:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def call_name(call: ast.Call) -> str:
+    return dotted(call.func)
+
+
+def last_seg(name: str) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def root_seg(name: str) -> str:
+    return name.split(".", 1)[0] if name else ""
+
+
+def kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+_FUNC_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class ModuleIndex:
+    """One-pass node index shared by every rule and the whole-program
+    build, so 19 rules don't each re-walk (and re-resolve dotted names
+    over) the same trees.  Built lazily on first access, cached on the
+    Module for the lifetime of the lint invocation.
+
+    ``scopes`` maps each function (plus the module tree itself) to the
+    nodes whose NEAREST enclosing function it is — nested function
+    bodies belong to the nested function's scope, matching the
+    scope-local taint rules (wall-clock, mixed-precision)."""
+
+    def __init__(self, tree: ast.AST):
+        self.nodes: List[ast.AST] = []
+        self.calls: List[Tuple[ast.Call, str]] = []
+        self.functions: List[ast.AST] = []
+        self.classes: List[ast.ClassDef] = []
+        self.scopes: List[Tuple[ast.AST, List[ast.AST]]] = []
+        self.enclosing: Dict[int, ast.AST] = {}  # id(node) -> function
+        scope_nodes: Dict[int, List[ast.AST]] = {id(tree): []}
+        scope_of: Dict[int, ast.AST] = {id(tree): tree}
+        stack: List[Tuple[ast.AST, ast.AST]] = [
+            (child, tree) for child in
+            reversed(list(ast.iter_child_nodes(tree)))]
+        while stack:
+            node, scope = stack.pop()
+            self.nodes.append(node)
+            scope_nodes[id(scope)].append(node)
+            child_scope = scope
+            if isinstance(node, ast.Call):
+                self.calls.append((node, dotted(node.func)))
+                self.enclosing[id(node)] = scope
+            elif isinstance(node, _FUNC_TYPES):
+                self.functions.append(node)
+                scope_nodes.setdefault(id(node), [])
+                scope_of[id(node)] = node
+                child_scope = node
+            elif isinstance(node, ast.ClassDef):
+                self.classes.append(node)
+            for child in reversed(list(ast.iter_child_nodes(node))):
+                stack.append((child, child_scope))
+        self.scopes = [(scope_of[k], v) for k, v in scope_nodes.items()]
 
 #: meta-rule: malformed / rationale-less / unknown-rule suppressions.
 BAD_SUPPRESSION = "bad-suppression"
@@ -88,11 +171,18 @@ class Module:
         self.guards: Dict[int, Guard] = {}
         self.comment_lines: Dict[int, str] = {}
         self.bad_pragmas: List[Tuple[int, str]] = []
+        self._index: Optional[ModuleIndex] = None
         self._scan_pragmas()
 
     @property
     def basename(self) -> str:
         return os.path.basename(self.path)
+
+    @property
+    def index(self) -> ModuleIndex:
+        if self._index is None:
+            self._index = ModuleIndex(self.tree)
+        return self._index
 
     def _scan_pragmas(self) -> None:
         comments: List[Tuple[int, int, str]] = []  # (line, col, text)
@@ -166,9 +256,20 @@ class Project:
 
     def __init__(self, modules: Sequence[Module]):
         self.modules = list(modules)
+        self._whole_program = None
 
     def by_basename(self, name: str) -> List[Module]:
         return [m for m in self.modules if m.basename == name]
+
+    def whole_program(self):
+        """The repo-wide symbol table / call graph (wholeprogram.py),
+        built once per lint invocation and shared by every
+        interprocedural rule (17/18/19)."""
+        if self._whole_program is None:
+            from .wholeprogram import WholeProgram
+
+            self._whole_program = WholeProgram(self)
+        return self._whole_program
 
 
 # -- file discovery ----------------------------------------------------
@@ -263,32 +364,94 @@ def lint_paths(paths: Iterable[str], root: Optional[str] = None,
             len(project.modules))
 
 
+# -- changed-only filtering --------------------------------------------
+
+def changed_files(root: str, base: Optional[str] = None) -> Set[str]:
+    """Repo-relative paths touched vs the git base: working-tree +
+    staged changes (plus untracked .py files), and — with ``base`` — the
+    committed diff ``base...HEAD`` too.  Raises RuntimeError when git
+    cannot answer (not a repo, bad base): --changed-only is a developer
+    convenience and must fail loudly rather than silently lint
+    nothing."""
+    cmds = [["git", "diff", "--name-only", "HEAD"],
+            ["git", "ls-files", "--others", "--exclude-standard"]]
+    if base:
+        cmds.append(["git", "diff", "--name-only", f"{base}...HEAD"])
+    changed: Set[str] = set()
+    for cmd in cmds:
+        proc = subprocess.run(cmd, cwd=root, capture_output=True,
+                              text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"--changed-only: {' '.join(cmd)} failed: "
+                f"{proc.stderr.strip() or proc.stdout.strip()}")
+        changed.update(ln.strip() for ln in proc.stdout.splitlines()
+                       if ln.strip())
+    return {c for c in changed if c.endswith(".py")}
+
+
 # -- rendering ---------------------------------------------------------
 
 def render_findings(findings: Sequence[Finding], files: int,
-                    as_json: bool = False) -> str:
+                    as_json: bool = False,
+                    rules: Optional[Sequence[str]] = None,
+                    changed_only: bool = False) -> str:
     if as_json:
-        return json.dumps(
-            {"version": 1, "files": files,
-             "findings": [f.to_json() for f in findings]},
-            indent=2, sort_keys=True)
+        payload: Dict[str, object] = {
+            "version": 1, "files": files,
+            "findings": [f.to_json() for f in findings]}
+        if rules is not None:
+            # the active rule catalog — gate.sh asserts the
+            # whole-program rules (17-19) are in force, not just clean
+            payload["rules"] = sorted(rules)
+        if changed_only:
+            payload["changed_only"] = True
+        return json.dumps(payload, indent=2, sort_keys=True)
+    suffix = " (changed files only)" if changed_only else ""
     if not findings:
-        return f"graftlint: {files} file(s) clean"
+        return f"graftlint: {files} file(s) clean{suffix}"
     lines = [f.render() for f in findings]
     lines.append(f"graftlint: {len(findings)} finding(s) in "
                  f"{len({f.path for f in findings})} file(s) "
-                 f"({files} scanned)")
+                 f"({files} scanned){suffix}")
     return "\n".join(lines)
+
+
+def active_rule_names() -> List[str]:
+    from . import rules as rules_mod
+
+    return [r.name for r in rules_mod.RULES] + [BAD_SUPPRESSION,
+                                                "parse-error"]
 
 
 def run_cli(argv: Optional[Sequence[str]] = None,
             json_output: bool = False,
             paths: Optional[Sequence[str]] = None,
-            root: Optional[str] = None) -> int:
-    """Shared CLI body for ``main.py lint`` and ``scripts/graftlint.py``."""
+            root: Optional[str] = None,
+            changed_only: bool = False,
+            base: Optional[str] = None) -> int:
+    """Shared CLI body for ``main.py lint`` and ``scripts/graftlint.py``.
+
+    ``changed_only`` lints only files touched vs the git base (see
+    ``changed_files``) — but ALWAYS loads the whole default scope first,
+    so the interprocedural rules (17-19) still see every symbol table /
+    call-graph edge; only the FINDINGS are filtered to changed files.
+    Whole-repo (the default) remains the gate contract; changed-only is
+    the fast inner-loop form.
+    """
     root = root or os.getcwd()
     scope = [os.path.join(root, p) for p in DEFAULT_SCOPE] \
         if not paths else list(paths)
     findings, files = lint_paths(scope, root=root)
-    print(render_findings(findings, files, as_json=json_output))
+    if changed_only:
+        try:
+            changed = changed_files(root, base)
+        except RuntimeError as e:
+            print(f"graftlint: {e}")
+            return 2
+        findings = [f for f in findings
+                    if f.path.replace(os.sep, "/") in changed]
+    print(render_findings(findings, files, as_json=json_output,
+                          rules=active_rule_names(),
+                          changed_only=changed_only))
     return 1 if findings else 0

@@ -13,49 +13,18 @@ from __future__ import annotations
 import ast
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
-from .core import Finding, Module, Project
+from .core import Finding, Module, Project, call_name, dotted, kwarg, \
+    last_seg, root_seg
+from .wholeprogram import FuncInfo, WholeProgram, display
 
 
-# -- shared AST helpers ------------------------------------------------
-
-def dotted(node: ast.AST) -> str:
-    """Best-effort dotted name for a call target / reference:
-    ``jax.lax.psum`` -> "jax.lax.psum", ``self._apply`` -> "self._apply",
-    anything unresolvable -> ""."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-    elif not parts:
-        return ""
-    return ".".join(reversed(parts))
-
-
-def call_name(call: ast.Call) -> str:
-    return dotted(call.func)
-
-
-def last_seg(name: str) -> str:
-    return name.rsplit(".", 1)[-1] if name else ""
-
-
-def root_seg(name: str) -> str:
-    return name.split(".", 1)[0] if name else ""
-
+# -- shared AST helpers (dotted/call_name/... live in core.py now, so
+# -- wholeprogram.py shares them without importing the rule catalog) ---
 
 def walk_calls(node: ast.AST) -> Iterator[ast.Call]:
     for n in ast.walk(node):
         if isinstance(n, ast.Call):
             yield n
-
-
-def kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
-    for kw in call.keywords:
-        if kw.arg == name:
-            return kw.value
-    return None
 
 
 def names_in(node: ast.AST) -> Set[str]:
@@ -126,7 +95,7 @@ class HostSyncInStepLoop(Rule):
         for mod in project.modules:
             if mod.basename not in self.TARGET_BASENAMES:
                 continue
-            for node in ast.walk(mod.tree):
+            for node in mod.index.nodes:
                 if isinstance(node, ast.For) \
                         and self._is_step_iter(node.iter):
                     for line, msg in self._sync_calls(node.body):
@@ -183,13 +152,13 @@ class TraceImpurity(Rule):
         roots: Set[str] = set()
         # local `x = functools.partial(f, ...)` bindings, module-wide
         local_partials: Dict[str, str] = {}
-        for node in ast.walk(mod.tree):
+        for node in mod.index.nodes:
             if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                     and isinstance(node.targets[0], ast.Name):
                 t = self._partial_target(node.value)
                 if t:
                     local_partials[node.targets[0].id] = last_seg(t)
-        for node in ast.walk(mod.tree):
+        for node in mod.index.nodes:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 for dec in node.decorator_list:
                     dn = dotted(dec)
@@ -215,9 +184,8 @@ class TraceImpurity(Rule):
     def _function_table(self, mod: Module
                         ) -> Dict[str, ast.FunctionDef]:
         table: Dict[str, ast.FunctionDef] = {}
-        for node in ast.walk(mod.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                table.setdefault(node.name, node)
+        for node in mod.index.functions:
+            table.setdefault(node.name, node)
         return table
 
     def _expand(self, roots: Set[str],
@@ -288,6 +256,50 @@ _COLLECTIVES = {"psum": 1, "pmean": 1, "pmax": 1, "pmin": 1,
                 "all_to_all": 1, "axis_index": 0}
 
 
+def declared_axes(project: Project) -> Set[str]:
+    """Every axis name some mesh constructor declares: ``*_AXIS``
+    string constants, plus literal ``Mesh(..., (names...))`` tuples.
+    Shared by rules 3 and 19."""
+    axes: Set[str] = set()
+    for mod in project.modules:
+        for node in mod.index.nodes:
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id.endswith("_AXIS") \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                axes.add(node.value.value)
+            elif isinstance(node, ast.Call) \
+                    and last_seg(call_name(node)) == "Mesh":
+                cands = list(node.args[1:2]) + [
+                    v for v in (kwarg(node, "axis_names"),)
+                    if v is not None]
+                for cand in cands:
+                    if isinstance(cand, (ast.Tuple, ast.List)):
+                        for el in cand.elts:
+                            if isinstance(el, ast.Constant) \
+                                    and isinstance(el.value, str):
+                                axes.add(el.value)
+    return axes
+
+
+def axis_constants(project: Project) -> Dict[str, str]:
+    """``*_AXIS`` constant name -> axis string, repo-wide.  Shared by
+    rules 3 and 19."""
+    consts: Dict[str, str] = {}
+    for mod in project.modules:
+        for node in mod.index.nodes:
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id.endswith("_AXIS") \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                consts[node.targets[0].id] = node.value.value
+    return consts
+
+
 class CollectiveAxisConsistency(Rule):
     """Every ``lax.psum/pmean/all_gather/ppermute/axis_index`` axis name
     must be an axis some mesh constructor declares (runtime.make_mesh's
@@ -298,51 +310,11 @@ class CollectiveAxisConsistency(Rule):
     name = "collective-axis-consistency"
     description = "collective axis names must match declared mesh axes"
 
-    def _declared_axes(self, project: Project) -> Set[str]:
-        axes: Set[str] = set()
-        for mod in project.modules:
-            for node in ast.walk(mod.tree):
-                if isinstance(node, ast.Assign) \
-                        and len(node.targets) == 1 \
-                        and isinstance(node.targets[0], ast.Name) \
-                        and node.targets[0].id.endswith("_AXIS") \
-                        and isinstance(node.value, ast.Constant) \
-                        and isinstance(node.value.value, str):
-                    axes.add(node.value.value)
-                elif isinstance(node, ast.Call) \
-                        and last_seg(call_name(node)) == "Mesh":
-                    cands = list(node.args[1:2]) + [
-                        v for v in (kwarg(node, "axis_names"),)
-                        if v is not None]
-                    for cand in cands:
-                        if isinstance(cand, (ast.Tuple, ast.List)):
-                            for el in cand.elts:
-                                if isinstance(el, ast.Constant) \
-                                        and isinstance(el.value, str):
-                                    axes.add(el.value)
-        return axes
-
-    def _axis_constants(self, project: Project) -> Dict[str, str]:
-        consts: Dict[str, str] = {}
-        for mod in project.modules:
-            for node in ast.walk(mod.tree):
-                if isinstance(node, ast.Assign) \
-                        and len(node.targets) == 1 \
-                        and isinstance(node.targets[0], ast.Name) \
-                        and node.targets[0].id.endswith("_AXIS") \
-                        and isinstance(node.value, ast.Constant) \
-                        and isinstance(node.value.value, str):
-                    consts[node.targets[0].id] = node.value.value
-        return consts
-
     def _param_defaults(self, mod: Module) -> Dict[Tuple[str, str], str]:
         """(function, param) -> string default, for axis args passed by
         parameter (``def f(..., axis_name='model')``)."""
         out: Dict[Tuple[str, str], str] = {}
-        for node in ast.walk(mod.tree):
-            if not isinstance(node, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
-                continue
+        for node in mod.index.functions:
             a = node.args
             pos = a.posonlyargs + a.args
             for param, default in zip(pos[len(pos) - len(a.defaults):],
@@ -370,20 +342,12 @@ class CollectiveAxisConsistency(Rule):
         return None
 
     def check(self, project: Project) -> Iterator[Finding]:
-        declared = self._declared_axes(project)
-        consts = self._axis_constants(project)
+        declared = declared_axes(project)
+        consts = axis_constants(project)
         for mod in project.modules:
             defaults = self._param_defaults(mod)
-            # map each call to its enclosing function for param defaults
-            enclosing: Dict[int, str] = {}
-            for node in ast.walk(mod.tree):
-                if isinstance(node, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
-                    for sub in ast.walk(node):
-                        if isinstance(sub, ast.Call):
-                            enclosing.setdefault(id(sub), node.name)
-            for call in walk_calls(mod.tree):
-                cn = call_name(call)
+            enclosing = mod.index.enclosing  # id(call) -> scope node
+            for call, cn in mod.index.calls:
                 seg = last_seg(cn)
                 if seg not in _COLLECTIVES or "lax" not in cn:
                     continue
@@ -393,8 +357,10 @@ class CollectiveAxisConsistency(Rule):
                     axis_arg = call.args[pos]
                 if axis_arg is None:
                     continue
+                scope = enclosing.get(id(call))
                 axis = self._resolve(axis_arg, consts,
-                                     enclosing.get(id(call)), defaults)
+                                     getattr(scope, "name", None),
+                                     defaults)
                 if axis is not None and axis not in declared:
                     yield self.finding(
                         mod, call.lineno,
@@ -516,10 +482,10 @@ class PrngReuse(Rule):
 
     def check(self, project: Project) -> Iterator[Finding]:
         for mod in project.modules:
-            for node in ast.walk(mod.tree):
-                if not isinstance(node, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef)):
-                    continue
+            if not any(s in mod.source
+                       for s in ("key", "split", "clone")):
+                continue  # no key construction: nothing to reuse
+            for node in mod.index.functions:
                 keys = self._key_vars(node)
                 if not keys:
                     continue
@@ -545,8 +511,7 @@ class MissingDonation(Rule):
                    "arg) without donate_argnums")
 
     def _defs(self, mod: Module) -> Dict[str, ast.FunctionDef]:
-        return {n.name: n for n in ast.walk(mod.tree)
-                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        return {n.name: n for n in mod.index.functions}
 
     def _train_state_first_arg(self, fn: ast.FunctionDef) -> bool:
         args = [a for a in fn.args.posonlyargs + fn.args.args
@@ -560,8 +525,8 @@ class MissingDonation(Rule):
     def check(self, project: Project) -> Iterator[Finding]:
         for mod in project.modules:
             defs = self._defs(mod)
-            for call in walk_calls(mod.tree):
-                if last_seg(call_name(call)) not in ("jit", "pjit"):
+            for call, cn in mod.index.calls:
+                if last_seg(cn) not in ("jit", "pjit"):
                     continue
                 if kwarg(call, "donate_argnums") is not None \
                         or kwarg(call, "donate_argnames") is not None:
@@ -725,7 +690,9 @@ class ThreadSharedState(Rule):
 
     def check(self, project: Project) -> Iterator[Finding]:
         for mod in project.modules:
-            for cls in ast.walk(mod.tree):
+            if "Thread" not in mod.source:
+                continue  # no thread construction: no shared state
+            for cls in mod.index.nodes:
                 if not isinstance(cls, ast.ClassDef):
                     continue
                 targets = self._thread_targets(cls)
@@ -775,7 +742,7 @@ class ConfigDrift(Rule):
                     and isinstance(node.targets[0], ast.Name) \
                     and node.targets[0].id.isupper():
                 constants[node.targets[0].id] = node.lineno
-        for node in ast.walk(mod.tree):
+        for node in mod.index.nodes:
             if isinstance(node, ast.ClassDef) and node.name == "Config":
                 for stmt in node.body:
                     if isinstance(stmt, ast.AnnAssign) \
@@ -804,7 +771,7 @@ class ConfigDrift(Rule):
             used_attrs: Set[str] = set()
             getattr_strings: Set[str] = set()
             for other in project.modules:
-                for node in ast.walk(other.tree):
+                for node in other.index.nodes:
                     if isinstance(node, ast.Name) \
                             and isinstance(node.ctx, ast.Load):
                         used_names.add(node.id)
@@ -878,7 +845,7 @@ class BareExcept(Rule):
 
     def check(self, project: Project) -> Iterator[Finding]:
         for mod in project.modules:
-            for node in ast.walk(mod.tree):
+            for node in mod.index.nodes:
                 if isinstance(node, ast.ExceptHandler) \
                         and self._is_broad(node) \
                         and not self._has_rationale(mod, node):
@@ -964,7 +931,7 @@ class RetryWithoutBackoff(Rule):
 
     def check(self, project: Project) -> Iterator[Finding]:
         for mod in project.modules:
-            for loop in ast.walk(mod.tree):
+            for loop in mod.index.nodes:
                 if not isinstance(loop, (ast.For, ast.While)) \
                         or not self._is_retry_loop(loop):
                     continue
@@ -1030,6 +997,8 @@ class ProfilerTraceLeak(Rule):
 
     def check(self, project: Project) -> Iterator[Finding]:
         for mod in project.modules:
+            if "start_trace" not in mod.source:
+                continue
             starts: List[Tuple] = []
             self._starts(mod.tree, None, None, starts)
             for call, fn, cls in starts:
@@ -1099,10 +1068,11 @@ class MixedPrecisionAccum(Rule):
                 dt = call.args[pos]
         return dt is not None and self._is_half_dtype(dt)
 
-    def _half_acc_vars(self, fn: ast.AST) -> Dict[str, int]:
-        """name -> creation line of half-dtype buffers assigned in fn."""
+    def _half_acc_vars(self, nodes: List[ast.AST]) -> Dict[str, int]:
+        """name -> creation line of half-dtype buffers assigned in the
+        scope (a node list from mod.index.scopes)."""
         out: Dict[str, int] = {}
-        for node in ast.walk(fn):
+        for node in nodes:
             if not isinstance(node, ast.Assign):
                 continue
             pairs: List[Tuple[ast.expr, ast.expr]] = []
@@ -1120,10 +1090,11 @@ class MixedPrecisionAccum(Rule):
                     out.setdefault(target.id, value.lineno)
         return out
 
-    def _accumulations(self, fn: ast.AST, halfvars: Dict[str, int]
+    def _accumulations(self, nodes: List[ast.AST],
+                       halfvars: Dict[str, int]
                        ) -> Iterator[Tuple[int, str, str]]:
         """(line, var, how) for each accumulation into a half buffer."""
-        for node in ast.walk(fn):
+        for node in nodes:
             if isinstance(node, ast.AugAssign) \
                     and isinstance(node.target, ast.Name) \
                     and node.target.id in halfvars \
@@ -1147,26 +1118,22 @@ class MixedPrecisionAccum(Rule):
     def check(self, project: Project) -> Iterator[Finding]:
         for mod in project.modules:
             # direct half-dtype reductions, anywhere in the module
-            for call in walk_calls(mod.tree):
-                if last_seg(call_name(call)) in self._REDUCERS:
+            for call, cn in mod.index.calls:
+                if last_seg(cn) in self._REDUCERS:
                     dt = kwarg(call, "dtype")
                     if dt is not None and self._is_half_dtype(dt):
                         yield self.finding(
                             mod, call.lineno,
-                            f"{call_name(call)}(dtype=half) accumulates "
+                            f"{cn}(dtype=half) accumulates "
                             f"in a half dtype — reduce in f32 (the "
                             f"default) and cast the result instead")
             # half-dtype accumulator buffers, per enclosing scope
-            scopes: List[ast.AST] = [mod.tree] + [
-                n for n in ast.walk(mod.tree)
-                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
             seen: Set[Tuple[int, str]] = set()
-            for scope in scopes:
-                halfvars = {
-                    k: v for k, v in self._half_acc_vars(scope).items()}
+            for _scope, nodes in mod.index.scopes:
+                halfvars = self._half_acc_vars(nodes)
                 if not halfvars:
                     continue
-                for line, var, how in self._accumulations(scope,
+                for line, var, how in self._accumulations(nodes,
                                                           halfvars):
                     if (line, var) in seen:
                         continue
@@ -1214,9 +1181,9 @@ class CollectiveInCleanup(Rule):
     def _has_rationale(self, mod: Module, line: int) -> bool:
         return mod.has_comment(line) or (line - 1) in mod.comment_lines
 
-    def _cleanup_bodies(self, tree: ast.AST
+    def _cleanup_bodies(self, mod: Module
                         ) -> Iterator[Tuple[str, List[ast.stmt]]]:
-        for node in ast.walk(tree):
+        for node in mod.index.nodes:
             if isinstance(node, ast.Try):
                 for handler in node.handlers:
                     yield "except", handler.body
@@ -1225,7 +1192,7 @@ class CollectiveInCleanup(Rule):
 
     def check(self, project: Project) -> Iterator[Finding]:
         for mod in project.modules:
-            for where, body in self._cleanup_bodies(mod.tree):
+            for where, body in self._cleanup_bodies(mod):
                 for stmt in body:
                     for call in walk_calls(stmt):
                         if last_seg(call_name(call)) \
@@ -1273,23 +1240,13 @@ class WallClockInMeasurement(Rule):
         return isinstance(node, ast.Call) \
             and call_name(node) == "time.time"
 
-    def _walk_scope(self, scope: ast.AST) -> Iterator[ast.AST]:
-        """Walk a scope WITHOUT descending into nested functions — a
-        name bound from time.time() in one function is a different
-        binding in another, and leaking taint across scopes turns the
-        rule into noise."""
-        stack = list(ast.iter_child_nodes(scope))
-        while stack:
-            n = stack.pop()
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            yield n
-            stack.extend(ast.iter_child_nodes(n))
-
-    def _tainted(self, scope: ast.AST) -> Set[str]:
-        """Names bound to a raw time.time() result in this scope."""
+    def _tainted(self, nodes: List[ast.AST]) -> Set[str]:
+        """Names bound to a raw time.time() result in this scope.
+        Scope-strict (mod.index.scopes): a name bound from time.time()
+        in one function is a different binding in another, and leaking
+        taint across scopes turns the rule into noise."""
         out: Set[str] = set()
-        for node in self._walk_scope(scope):
+        for node in nodes:
             if isinstance(node, ast.Assign) \
                     and self._is_wall_call(node.value):
                 for t in node.targets:
@@ -1299,12 +1256,9 @@ class WallClockInMeasurement(Rule):
 
     def check(self, project: Project) -> Iterator[Finding]:
         for mod in project.modules:
-            scopes: List[ast.AST] = [mod.tree] + [
-                n for n in ast.walk(mod.tree)
-                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
-            for scope in scopes:
-                tainted = self._tainted(scope)
-                for node in self._walk_scope(scope):
+            for _scope, nodes in mod.index.scopes:
+                tainted = self._tainted(nodes)
+                for node in nodes:
                     if isinstance(node, ast.BinOp) \
                             and isinstance(node.op, ast.Sub):
                         operands = (node.left, node.right)
@@ -1372,7 +1326,7 @@ class BlockingH2dInStepLoop(Rule):
         for mod in project.modules:
             if mod.basename not in self.TARGET_BASENAMES:
                 continue
-            for node in ast.walk(mod.tree):
+            for node in mod.index.nodes:
                 if not (isinstance(node, ast.For)
                         and self._is_step_iter(node.iter)):
                     continue
@@ -1472,7 +1426,7 @@ class UnboundedQueueInServer(Rule):
         for mod in project.modules:
             if not self._targets(mod):
                 continue
-            for node in ast.walk(mod.tree):
+            for node in mod.index.nodes:
                 if isinstance(node, ast.Call) \
                         and self._unbounded_ctor(node):
                     if self._has_rationale(mod, node.lineno):
@@ -1577,10 +1531,10 @@ class UnboundedMetricCardinality(Rule):
         for mod in project.modules:
             if not self._targets(mod):
                 continue
-            for node in ast.walk(mod.tree):
-                if not isinstance(node, ast.Call) or not node.args:
+            for node, cn in mod.index.calls:
+                if not node.args:
                     continue
-                callee = last_seg(call_name(node))
+                callee = last_seg(cn)
                 if callee.lower() not in self.METRIC_CALLS \
                         and callee != "Histogram":
                     continue
@@ -1600,6 +1554,511 @@ class UnboundedMetricCardinality(Rule):
                     f"value set is fixed")
 
 
+# -- 17. collective-divergence (whole-program) -------------------------
+
+#: jax.lax collectives — every rank in the axis must call them.
+_LAX_COLLECTIVE_SEGS = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                        "all_to_all", "ppermute", "psum_scatter",
+                        "pbroadcast"}
+#: multihost rendezvous helpers — every PROCESS must call them.
+_MULTIHOST_SEGS = {"sync_global_devices", "process_allgather",
+                   "broadcast_one_to_all",
+                   "host_local_array_to_global_array"}
+#: condition fragments that mean "this branch is rank-dependent".
+_RANK_CALL_SEGS = {"process_index", "is_main", "is_coordinator"}
+
+
+def _leaf_collective(cn: str) -> Optional[str]:
+    """The collective-registry leaf a raw dotted call name names, or
+    None.  lax collectives require a lax-ish prefix so a method named
+    ``psum`` on some class doesn't count; the multihost helpers are
+    distinctive enough to match by segment."""
+    seg = last_seg(cn)
+    if seg in _LAX_COLLECTIVE_SEGS and "lax" in cn:
+        return seg
+    if seg in _MULTIHOST_SEGS:
+        return seg
+    return None
+
+
+def _rank_named(seg: str) -> bool:
+    return seg == "rank" or seg.endswith("_rank") \
+        or seg.startswith("rank_")
+
+
+def _terminates(body: List[ast.stmt]) -> bool:
+    """The branch provably exits the function/loop: ends in
+    return/raise/break/continue or a sys.exit/os._exit call."""
+    if not body:
+        return False
+    tail = body[-1]
+    if isinstance(tail, (ast.Return, ast.Raise, ast.Break,
+                         ast.Continue)):
+        return True
+    return isinstance(tail, ast.Expr) \
+        and isinstance(tail.value, ast.Call) \
+        and last_seg(call_name(tail.value)) in ("exit", "_exit")
+
+
+class CollectiveDivergence(Rule):
+    """The SPMD contract: every rank executes the same collectives in
+    the same order, or the world hangs at the next mismatched
+    rendezvous.  This rule finds the static form of that hang: a
+    collective (a jax.lax/multihost call directly, or any function that
+    transitively reaches one over the whole-program call graph —
+    runtime.barrier, checkpoint saves with orbax barriers, elastic
+    rendezvous) that executes only under RANK-DEPENDENT control flow:
+
+      * lexically inside an ``if`` whose condition reads
+        ``process_index()`` / ``is_main()`` / a ``*rank*``-named value
+        (directly or through a tainted local), or
+      * after an early-exit guard on such a condition
+        (``if not is_main(): return`` ... collective), which is the
+        same divergence one indentation level flatter.
+
+    Uniform conditions (``process_count() > 1``) evaluate identically
+    on every rank and are NOT rank-dependent.  Deliberate
+    coordinator-only protocols (elastic publishes where the
+    non-coordinators are provably parked elsewhere) carry a
+    ``# graftlint: disable=collective-divergence -- <why>`` pragma."""
+
+    name = "collective-divergence"
+    description = ("collective reachable only under rank-dependent "
+                   "control flow — ranks that skip it hang the world")
+
+    def _reaching(self, wp: WholeProgram,
+                  direct: Dict[str, Set[str]],
+                  cache: Dict[str, Set[str]], qname: str) -> Set[str]:
+        got = cache.get(qname)
+        if got is None:
+            got = set(direct.get(qname, ()))
+            for callee in wp.transitive_callees(qname):
+                got |= direct.get(callee, set())
+            cache[qname] = got
+        return got
+
+    def _scope_assigns(self, body: List[ast.stmt],
+                       out: List[ast.Assign]) -> None:
+        """Assign statements lexically in THIS scope (nested def/class
+        bodies are their own scopes and are skipped)."""
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.Assign):
+                out.append(stmt)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if sub:
+                    self._scope_assigns(sub, out)
+            for h in getattr(stmt, "handlers", ()):
+                self._scope_assigns(h.body, out)
+
+    def _tainted_locals(self, fi: FuncInfo) -> Set[str]:
+        out: Set[str] = set()
+        assigns: List[ast.Assign] = []
+        self._scope_assigns(fi.body, assigns)
+        for node in assigns:
+            tainted = False
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Call) and last_seg(
+                        call_name(sub)) in _RANK_CALL_SEGS:
+                    tainted = True
+                elif isinstance(sub, (ast.Name, ast.Attribute)) \
+                        and _rank_named(last_seg(dotted(sub))):
+                    tainted = True
+            if tainted:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
+
+    def _taint_reason(self, test: ast.expr,
+                      tainted: Set[str]) -> Optional[str]:
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Call):
+                seg = last_seg(call_name(sub))
+                if seg in _RANK_CALL_SEGS:
+                    return f"{call_name(sub)}()"
+            elif isinstance(sub, (ast.Name, ast.Attribute)):
+                seg = last_seg(dotted(sub))
+                if _rank_named(seg):
+                    return seg
+                if isinstance(sub, ast.Name) and sub.id in tainted:
+                    return f"{sub.id} (rank-derived)"
+        return None
+
+    def _flag_calls(self, wp, direct, cache, fi, node, reason,
+                    out: List[Tuple[ast.Call, str, str]]) -> None:
+        for call in walk_calls(node):
+            cn = call_name(call)
+            leaf = _leaf_collective(cn)
+            if leaf is not None:
+                out.append((call, f"{cn}()", reason))
+                continue
+            q = wp.resolved.get(id(call))
+            if q is None:
+                continue
+            leaves = self._reaching(wp, direct, cache, q)
+            if leaves:
+                out.append((
+                    call,
+                    f"{cn}() (reaches "
+                    f"{'/'.join(sorted(leaves))} via {display(q)})",
+                    reason))
+
+    def _scan(self, wp, direct, cache, fi, body: List[ast.stmt],
+              tainted: Set[str], diverged: Optional[str],
+              out: List[Tuple[ast.Call, str, str]]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # separate scopes, analyzed on their own
+            if isinstance(stmt, (ast.If, ast.While)):
+                reason = self._taint_reason(stmt.test, tainted)
+                if reason is not None:
+                    why = (f"inside a branch on {reason} "
+                           f"(line {stmt.lineno})")
+                    self._flag_calls(wp, direct, cache, fi, stmt,
+                                     why, out)
+                    if isinstance(stmt, ast.If) \
+                            and (_terminates(stmt.body)
+                                 or _terminates(stmt.orelse)):
+                        diverged = (f"after the rank-dependent early "
+                                    f"exit on {reason} "
+                                    f"(line {stmt.lineno})")
+                    continue
+                self._scan(wp, direct, cache, fi, stmt.body, tainted,
+                           diverged, out)
+                self._scan(wp, direct, cache, fi, stmt.orelse, tainted,
+                           diverged, out)
+                continue
+            if diverged is not None:
+                self._flag_calls(wp, direct, cache, fi, stmt,
+                                 diverged, out)
+            sub_bodies: List[List[ast.stmt]] = []
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                sub_bodies = [stmt.body, stmt.orelse]
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                sub_bodies = [stmt.body]
+            elif isinstance(stmt, ast.Try):
+                sub_bodies = ([stmt.body, stmt.orelse, stmt.finalbody]
+                              + [h.body for h in stmt.handlers])
+            for sub in sub_bodies:
+                self._scan(wp, direct, cache, fi, sub, tainted,
+                           diverged, out)
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        wp = project.whole_program()
+        cn_of = {id(c): cn for m in project.modules
+                 for c, cn in m.index.calls}
+        direct: Dict[str, Set[str]] = {}
+        for caller, calls in wp.calls_of.items():
+            leaves = {_leaf_collective(cn_of.get(id(c), ""))
+                      for c in calls}
+            leaves.discard(None)
+            if leaves:
+                direct[caller] = leaves  # type: ignore[assignment]
+        cache: Dict[str, Set[str]] = {}
+        for fi in wp.all_scopes():
+            flagged: List[Tuple[ast.Call, str, str]] = []
+            self._scan(wp, direct, cache, fi, fi.body,
+                       self._tainted_locals(fi), None, flagged)
+            seen: Set[int] = set()
+            for call, what, why in flagged:
+                if id(call) in seen:
+                    continue
+                seen.add(id(call))
+                yield Finding(
+                    self.name, fi.module.rel, call.lineno,
+                    f"{what} runs only {why}: ranks that skip this "
+                    f"path never reach the matching collective and "
+                    f"the world hangs — make every rank execute it, "
+                    f"or suppress with a rationale if the excluded "
+                    f"ranks are provably parked elsewhere")
+
+
+# -- 18. lock-order-cycle (whole-program) ------------------------------
+
+class LockOrderCycle(Rule):
+    """The static lock-acquisition graph over every lock-holding module
+    (telemetry, flightrec, goodput, tracing, fleet, serving, faults,
+    checkpoint, data/pipeline, costs): an edge A -> B when lock B is
+    acquired (``with``/``acquire()``) while A is provably held — in the
+    same function or through any resolved call chain.  Findings:
+
+      * a CYCLE in the graph (two threads taking the locks in opposite
+        orders deadlock);
+      * a non-reentrant lock re-acquirable while already held on the
+        same chain (self-deadlock through a call);
+      * a SIGNAL HANDLER that can transitively acquire a non-reentrant
+        ``threading.Lock`` / ``Condition(Lock())`` — the PR 12 bug
+        class: the handler interrupts the very thread that may already
+        hold the lock, and the process deadlocks on itself.  Handler-
+        reachable locks must be RLock (or the handler lock-free)."""
+
+    name = "lock-order-cycle"
+    description = ("lock-acquisition cycles, held-lock re-acquisition, "
+                   "and signal handlers that can take a non-reentrant "
+                   "lock")
+
+    def _acquire_stmt(self, wp: WholeProgram, fi: FuncInfo,
+                      stmt: ast.stmt) -> Optional[str]:
+        value = stmt.value if isinstance(stmt, (ast.Expr, ast.Assign)) \
+            else None
+        if isinstance(value, ast.Call) \
+                and isinstance(value.func, ast.Attribute) \
+                and value.func.attr == "acquire":
+            return wp.resolve_lock(fi.modname, fi.cls, fi.env,
+                                   value.func.value)
+        return None
+
+    def _scan_stmts(self, wp, fi, stmts: List[ast.stmt],
+                    held: Tuple[str, ...], events: List,
+                    direct: Dict[str, Set[str]],
+                    sites: Dict[str, Tuple[Module, int]]) -> None:
+        for i, stmt in enumerate(stmts):
+            if isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                # runs later, not under the current holds
+                self._scan_stmts(wp, fi, stmt.body, (), events,
+                                 direct, sites)
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                continue  # methods have their own FuncInfo
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                cur = held
+                for item in stmt.items:
+                    lid = wp.resolve_lock(fi.modname, fi.cls, fi.env,
+                                          item.context_expr)
+                    if lid is not None:
+                        self._acquire(fi, cur, lid, stmt.lineno,
+                                      events, direct, sites)
+                        cur = cur + (lid,)
+                self._scan_stmts(wp, fi, stmt.body, cur, events,
+                                 direct, sites)
+                continue
+            lid = self._acquire_stmt(wp, fi, stmt)
+            if lid is not None:
+                self._acquire(fi, held, lid, stmt.lineno, events,
+                              direct, sites)
+                # held until function end (release() not modeled)
+                self._scan_stmts(wp, fi, stmts[i + 1:],
+                                 held + (lid,), events, direct, sites)
+                return
+            for sub in self._sub_bodies(stmt):
+                self._scan_stmts(wp, fi, sub, held, events, direct,
+                                 sites)
+            if held:
+                for call in walk_calls(stmt):
+                    q = wp.resolved.get(id(call))
+                    if q is not None:
+                        events.append((fi, held, "call", q,
+                                       call.lineno))
+
+    @staticmethod
+    def _sub_bodies(stmt: ast.stmt) -> List[List[ast.stmt]]:
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            return [stmt.body, stmt.orelse]
+        if isinstance(stmt, ast.If):
+            return [stmt.body, stmt.orelse]
+        if isinstance(stmt, ast.Try):
+            return [stmt.body, stmt.orelse, stmt.finalbody] \
+                + [h.body for h in stmt.handlers]
+        return []
+
+    def _acquire(self, fi, held, lid, lineno, events, direct,
+                 sites) -> None:
+        direct.setdefault(fi.qname, set()).add(lid)
+        sites.setdefault(lid, (fi.module, lineno))
+        events.append((fi, held, "lock", lid, lineno))
+
+    @staticmethod
+    def _lock_disp(lid: str) -> str:
+        return display(lid)
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        wp = project.whole_program()
+        if not wp.locks:
+            return
+        events: List = []
+        direct: Dict[str, Set[str]] = {}
+        acq_sites: Dict[str, Tuple[Module, int]] = {}
+        for fi in wp.all_scopes():
+            self._scan_stmts(wp, fi, fi.body, (), events, direct,
+                             acq_sites)
+
+        def closure(qname: str) -> Set[str]:
+            got = set(direct.get(qname, ()))
+            for callee in wp.transitive_callees(qname):
+                got |= direct.get(callee, set())
+            return got
+
+        # edges: (A, B) -> (fi, lineno, via) at the first site seen
+        edges: Dict[Tuple[str, str], Tuple] = {}
+        for fi, held, kind, target, lineno in events:
+            if kind == "lock":
+                acquired = {target}
+                via = None
+            else:
+                acquired = closure(target)
+                via = target
+            for b in acquired:
+                for a in held:
+                    edges.setdefault((a, b), (fi, lineno, via))
+
+        # re-acquisition of a held non-reentrant lock (self-deadlock)
+        for (a, b), (fi, lineno, via) in sorted(edges.items()):
+            if a == b and wp.non_reentrant(a):
+                how = (f"through {display(via)}" if via is not None
+                       else "directly")
+                yield Finding(
+                    self.name, fi.module.rel, lineno,
+                    f"non-reentrant {wp.locks[a]} "
+                    f"{self._lock_disp(a)} can be re-acquired {how} "
+                    f"while already held: the second acquire blocks "
+                    f"forever on the first — use threading.RLock() "
+                    f"or restructure so the lock is taken once")
+
+        # cycles (A -> B -> ... -> A), canonicalized by smallest start
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            if a != b:
+                graph.setdefault(a, set()).add(b)
+
+        def cycles_from(start: str, path: List[str],
+                        found: List[List[str]]) -> None:
+            for nxt in sorted(graph.get(path[-1], ())):
+                if nxt == start:
+                    found.append(path[:])
+                elif nxt > start and nxt not in path and len(path) < 6:
+                    cycles_from(start, path + [nxt], found)
+
+        for start in sorted(graph):
+            found: List[List[str]] = []
+            cycles_from(start, [start], found)
+            for cyc in found:
+                fi, lineno, via = edges[(cyc[0], cyc[1 % len(cyc)])]
+                chain = " -> ".join(self._lock_disp(c)
+                                    for c in cyc + [cyc[0]])
+                yield Finding(
+                    self.name, fi.module.rel, lineno,
+                    f"lock-order cycle {chain}: two threads taking "
+                    f"these locks in opposite orders deadlock — pick "
+                    f"one global order (or collapse to one lock)")
+
+        # signal handlers reaching non-reentrant locks (PR 12 class)
+        for hq, hmod, hline in wp.handlers:
+            reach = closure(hq)
+            for lid in sorted(reach):
+                if not wp.non_reentrant(lid):
+                    continue
+                owners = {q for q, s in direct.items() if lid in s}
+                path = wp.call_path(hq, owners)
+                via = " -> ".join(display(q) for q in path) \
+                    if path else display(hq)
+                lmod, lline = wp.lock_sites.get(lid, (hmod, hline))
+                yield Finding(
+                    self.name, hmod.rel, hline,
+                    f"signal handler {display(hq)} can acquire "
+                    f"non-reentrant {wp.locks[lid]} "
+                    f"{self._lock_disp(lid)} ({lmod.rel}:{lline}) "
+                    f"via {via}: if the signal lands while this "
+                    f"thread already holds it, the process "
+                    f"self-deadlocks — make it an RLock or keep the "
+                    f"handler lock-free")
+
+
+# -- 19. mesh-axis-propagation (whole-program) -------------------------
+
+class MeshAxisPropagation(Rule):
+    """Rule 3 resolves collective axis names INSIDE one file (literals,
+    ``*_AXIS`` constants, same-function defaults).  This rule follows
+    the remaining case across files: a collective whose axis name is a
+    function PARAMETER, resolved at every interprocedural call site —
+    ``engine.step(axis_name="dtaa")`` three files away from the
+    ``lax.psum(x, axis_name)`` it misconfigures.  The mechanical form
+    of the ShardingPlan refactor's axis-flow audit (ROADMAP)."""
+
+    name = "mesh-axis-propagation"
+    description = ("axis-name argument flowing through call chains "
+                   "into a collective must match a declared mesh axis")
+
+    _MAX_DEPTH = 3
+
+    def _actual_arg(self, wp: WholeProgram, fi: FuncInfo, param: str,
+                    call: ast.Call) -> Optional[ast.expr]:
+        got = kwarg(call, param)
+        if got is not None:
+            return got
+        if param not in fi.params:
+            return None
+        idx = fi.params.index(param)
+        if fi.cls is not None and not wp.call_bound.get(id(call),
+                                                        True):
+            idx += 1  # unbound Cls.meth(obj, ...) fills self first
+        return call.args[idx] if idx < len(call.args) else None
+
+    def _flows(self, wp: WholeProgram, fi: FuncInfo, param: str,
+               consts: Dict[str, str], depth: int
+               ) -> Iterator[Tuple[str, Module, int, str]]:
+        """(axis value, site module, site line, chain) for every call
+        site that pins this parameter to a concrete axis name."""
+        if depth > self._MAX_DEPTH:
+            return
+        for caller_q, call, cmod in wp.call_sites.get(fi.qname, ()):
+            actual = self._actual_arg(wp, fi, param, call)
+            if actual is None:
+                continue  # default applies: rule 3's intra-file case
+            if isinstance(actual, ast.Constant) \
+                    and isinstance(actual.value, str):
+                yield (actual.value, cmod, call.lineno,
+                       f"{display(caller_q)} -> {fi.display}")
+            elif isinstance(actual, (ast.Name, ast.Attribute)) \
+                    and last_seg(dotted(actual)) in consts:
+                yield (consts[last_seg(dotted(actual))], cmod,
+                       call.lineno,
+                       f"{display(caller_q)} -> {fi.display}")
+            elif isinstance(actual, ast.Name):
+                cfi = wp.functions.get(caller_q)
+                if cfi is not None and actual.id in cfi.kwparams:
+                    for axis, smod, sline, chain in self._flows(
+                            wp, cfi, actual.id, consts, depth + 1):
+                        yield (axis, smod, sline,
+                               f"{chain} -> {fi.display}")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        wp = project.whole_program()
+        declared = declared_axes(project)
+        consts = axis_constants(project)
+        for mod in project.modules:
+            for call, cn in mod.index.calls:
+                seg = last_seg(cn)
+                if seg not in _COLLECTIVES or "lax" not in cn:
+                    continue
+                pos = _COLLECTIVES[seg]
+                axis_arg = kwarg(call, "axis_name")
+                if axis_arg is None and len(call.args) > pos:
+                    axis_arg = call.args[pos]
+                if not isinstance(axis_arg, ast.Name):
+                    continue
+                fi = wp.functions.get(wp.call_caller.get(id(call), ""))
+                if fi is None or axis_arg.id not in fi.kwparams:
+                    continue
+                for axis, smod, sline, chain in self._flows(
+                        wp, fi, axis_arg.id, consts, 0):
+                    if axis in declared:
+                        continue
+                    yield Finding(
+                        self.name, smod.rel, sline,
+                        f"axis {axis!r} flows through {chain} into "
+                        f"{cn}() at {mod.rel}:{call.lineno}, but no "
+                        f"mesh constructor declares it (declared: "
+                        f"{sorted(declared)}) — the collective "
+                        f"unbinds at runtime only for configs that "
+                        f"reach this call chain")
+
+
 RULES = (
     HostSyncInStepLoop(),
     TraceImpurity(),
@@ -1617,6 +2076,9 @@ RULES = (
     BlockingH2dInStepLoop(),
     UnboundedQueueInServer(),
     UnboundedMetricCardinality(),
+    CollectiveDivergence(),
+    LockOrderCycle(),
+    MeshAxisPropagation(),
 )
 
 RULES_BY_NAME = {r.name: r for r in RULES}

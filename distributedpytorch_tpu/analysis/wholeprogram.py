@@ -1,0 +1,711 @@
+"""Whole-program symbol table and call graph for graftlint v2.
+
+PR 3's rules are per-file; the bug classes that survived it are
+cross-file: a collective reached through two calls under a
+rank-dependent branch, a lock acquired while another module's lock is
+held, a mesh axis name threaded through a call chain.  This module
+builds, ONCE per lint invocation (memoized on ``Project``), the shared
+substrate those interprocedural rules (17/18/19) consume:
+
+  * module naming + per-module import alias tables (absolute, aliased,
+    relative at any depth, including imports inside function bodies —
+    ``utils.GracefulShutdown._handle`` does ``from . import telemetry``
+    inside the handler);
+  * registered functions (module-level defs and class methods) and
+    classes, with parameter lists, resolved return-annotation types,
+    attribute types (``self.x = ClassName(...)``), annotated module
+    globals (``_plan: Optional[FaultPlan] = None``) and factory return
+    types (``telemetry.get() -> Telemetry``);
+  * a resolved call graph: every ``ast.Call`` mapped to the internal
+    function it targets where resolution is possible — bare names,
+    ``module.func``, ``self.method``, ``self.attr.method``,
+    ``var.method`` for vars of known type, and chained factory calls
+    (``telemetry.get().event(...)``); unresolvable receivers are
+    skipped silently (the rules overapproximate on reachability, never
+    on identity);
+  * a signal-handler registry (``signal.signal(sig, X)`` with ``X``
+    resolved) — the entry points through which rule 18 checks
+    handler-reachable non-reentrant locks (the PR 12 deadlock class);
+  * a lock inventory: module-level and class-attribute
+    ``threading.Lock/RLock/Condition`` objects with reentrancy kinds
+    (``Condition()`` defaults to an RLock and is reentrant;
+    ``Condition(Lock())`` is not).
+
+Nested functions are merged into their nearest registered enclosing
+function (their calls are attributed to it) — a deliberate
+overapproximation that keeps closures visible to reachability without
+modeling first-class function values.  Single-module, single-level
+inheritance is resolved for method lookup; anything fancier falls back
+to "unresolved", which the rules treat as silence, not as a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Module, Project, call_name, dotted, kwarg, last_seg, \
+    root_seg
+
+_FUNC_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: lock constructors the inventory recognizes, by alias-expanded name.
+_LOCK_CTORS = {"threading.Lock", "threading.RLock",
+               "threading.Condition"}
+
+#: lock kinds that deadlock when re-acquired by the same thread —
+#: i.e. when a signal handler interrupts a holder (rule 18).
+NON_REENTRANT_KINDS = {"Lock", "Condition(Lock)"}
+
+
+def module_name(rel: str) -> str:
+    """Repo-relative path -> dotted module name
+    (``distributedpytorch_tpu/data/pipeline.py`` ->
+    ``distributedpytorch_tpu.data.pipeline``; a package ``__init__.py``
+    names the package itself)."""
+    name = rel.replace("\\", "/")
+    if name.endswith(".py"):
+        name = name[:-3]
+    if name.endswith("/__init__"):
+        name = name[: -len("/__init__")]
+    return name.replace("/", ".")
+
+
+def display(qname: str) -> str:
+    """Human form of a qualified name for findings:
+    ``distributedpytorch_tpu.faults:FaultPlan.fire`` ->
+    ``faults.FaultPlan.fire``."""
+    if ":" not in qname:
+        return qname
+    modname, sym = qname.split(":", 1)
+    sym = sym or "<module>"
+    return f"{last_seg(modname)}.{sym}"
+
+
+class FuncInfo:
+    """One registered function (module-level def or class method), or a
+    module's top-level statement scope (``qname`` ends ``:<module>``)."""
+
+    __slots__ = ("qname", "modname", "module", "node", "cls", "params",
+                 "kwparams", "returns", "env", "lineno")
+
+    def __init__(self, qname: str, modname: str, module: Module,
+                 node: ast.AST, cls: Optional[str]):
+        self.qname = qname
+        self.modname = modname
+        self.module = module
+        self.node = node
+        self.cls = cls
+        self.returns: Optional[str] = None
+        self.env: Dict[str, str] = {}  # local var -> class qname
+        self.lineno = getattr(node, "lineno", 0)
+        params: List[str] = []
+        kwparams: List[str] = []
+        if isinstance(node, _FUNC_TYPES):
+            a = node.args
+            params = [p.arg for p in a.posonlyargs + a.args]
+            if cls is not None and params and params[0] in ("self",
+                                                           "cls"):
+                params = params[1:]
+            kwparams = [p.arg for p in a.kwonlyargs]
+        self.params = params
+        self.kwparams = set(params) | set(kwparams)
+
+    @property
+    def body(self) -> List[ast.stmt]:
+        return self.node.body
+
+    @property
+    def display(self) -> str:
+        return display(self.qname)
+
+
+class ClassInfo:
+    """One class: its direct methods, resolved bases, and the types of
+    ``self.<attr>`` assignments resolvable without local context."""
+
+    __slots__ = ("qname", "modname", "module", "node", "attr_types",
+                 "bases")
+
+    def __init__(self, qname: str, modname: str, module: Module,
+                 node: ast.ClassDef):
+        self.qname = qname
+        self.modname = modname
+        self.module = module
+        self.node = node
+        self.attr_types: Dict[str, str] = {}  # attr -> class qname
+        self.bases: List[str] = []            # resolved base qnames
+
+
+class WholeProgram:
+    """The repo-wide symbol table / call graph.  Build once via
+    ``project.whole_program()``; every accessor after construction is
+    read-only."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.mod_by_name: Dict[str, Module] = {}
+        self.modname_of: Dict[int, str] = {}      # id(Module) -> name
+        self.aliases: Dict[str, Dict[str, str]] = {}
+        self.functions: Dict[str, FuncInfo] = {}
+        self.module_scopes: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.global_types: Dict[str, str] = {}    # "mod:var" -> class q
+        self.locks: Dict[str, str] = {}           # lock id -> kind
+        self.lock_sites: Dict[str, Tuple[Module, int]] = {}
+        self.resolved: Dict[int, str] = {}        # id(call) -> qname
+        self.call_bound: Dict[int, bool] = {}
+        self.call_caller: Dict[int, str] = {}
+        self.calls_of: Dict[str, List[ast.Call]] = {}
+        self.callees: Dict[str, Set[str]] = {}
+        self.call_sites: Dict[str, List[Tuple[str, ast.Call, Module]]] \
+            = {}
+        #: (handler qname, registering Module, signal.signal() lineno)
+        self.handlers: List[Tuple[str, Module, int]] = []
+        self._func_of_node: Dict[int, str] = {}
+        self._trans: Dict[str, Set[str]] = {}
+        self._build_names()
+        self._build_symbols()
+        self._build_types()
+        self._build_callgraph()
+
+    # -- naming and aliases --------------------------------------------
+
+    def _build_names(self) -> None:
+        for mod in self.project.modules:
+            name = module_name(mod.rel)
+            self.mod_by_name[name] = mod
+            self.modname_of[id(mod)] = name
+
+    def _package_of(self, mod: Module, modname: str) -> str:
+        if mod.basename == "__init__.py":
+            return modname
+        return modname.rsplit(".", 1)[0] if "." in modname else ""
+
+    def _scan_aliases(self, mod: Module, modname: str) -> None:
+        table: Dict[str, str] = {}
+        package = self._package_of(mod, modname)
+        for node in mod.index.nodes:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        table[alias.asname] = alias.name
+                    # a bare `import a.b` binds root "a" to itself;
+                    # the identity mapping is implicit in expand()
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = package
+                    for _ in range(node.level - 1):
+                        base = base.rsplit(".", 1)[0] \
+                            if "." in base else ""
+                    target = (f"{base}.{node.module}" if node.module
+                              else base)
+                else:
+                    target = node.module or ""
+                if not target:
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    table[alias.asname or alias.name] = \
+                        f"{target}.{alias.name}"
+        self.aliases[modname] = table
+
+    def expand(self, modname: str, name: str) -> str:
+        """Alias-expand the root segment of a dotted name as used in
+        ``modname`` (``rt.barrier`` -> ``…runtime.barrier``)."""
+        root = root_seg(name)
+        target = self.aliases.get(modname, {}).get(root)
+        if target is None:
+            return name
+        return target + name[len(root):]
+
+    def split_symbol(self, full: str
+                     ) -> Tuple[Optional[str], str]:
+        """Split an expanded dotted name at the longest known-module
+        prefix: ``…analysis.core.Finding`` -> (``…analysis.core``,
+        ``Finding``).  (None, full) when no prefix is a module."""
+        parts = full.split(".")
+        for i in range(len(parts), 0, -1):
+            prefix = ".".join(parts[:i])
+            if prefix in self.mod_by_name:
+                return prefix, ".".join(parts[i:])
+        return None, full
+
+    # -- symbols -------------------------------------------------------
+
+    def _build_symbols(self) -> None:
+        for mod in self.project.modules:
+            modname = self.modname_of[id(mod)]
+            self._scan_aliases(mod, modname)
+            scope_qname = f"{modname}:<module>"
+            self.module_scopes[scope_qname] = FuncInfo(
+                scope_qname, modname, mod, mod.tree, None)
+            method_ids: Set[int] = set()
+            for cls in mod.index.classes:
+                cq = f"{modname}:{cls.name}"
+                self.classes[cq] = ClassInfo(cq, modname, mod, cls)
+                for stmt in cls.body:
+                    if isinstance(stmt, _FUNC_TYPES):
+                        method_ids.add(id(stmt))
+                        q = f"{modname}:{cls.name}.{stmt.name}"
+                        self.functions[q] = FuncInfo(
+                            q, modname, mod, stmt, cls.name)
+                        self._func_of_node[id(stmt)] = q
+            # module-level defs: functions whose nearest enclosing
+            # function scope is the module itself and that are not
+            # class methods (class bodies are not function scopes)
+            for scope, nodes in mod.index.scopes:
+                if scope is not mod.tree:
+                    continue
+                for node in nodes:
+                    if isinstance(node, _FUNC_TYPES) \
+                            and id(node) not in method_ids:
+                        q = f"{modname}:{node.name}"
+                        self.functions[q] = FuncInfo(
+                            q, modname, mod, node, None)
+                        self._func_of_node[id(node)] = q
+
+    # -- types ---------------------------------------------------------
+
+    def _resolve_annotation(self, modname: str,
+                            ann: Optional[ast.expr]) -> Optional[str]:
+        """A type annotation resolved to an internal class qname:
+        ``Telemetry``, ``"Tracer"``, ``Optional[FaultPlan]``."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(ann, ast.Subscript):
+            if last_seg(dotted(ann.value)) in ("Optional", "Final",
+                                               "ClassVar"):
+                return self._resolve_annotation(modname, ann.slice)
+            return None
+        name = dotted(ann)
+        if not name:
+            return None
+        r = self.resolve_symbol(modname, name)
+        if r is not None and r[0] == "class":
+            return r[1]
+        return None
+
+    def _lock_kind(self, modname: str,
+                   value: ast.expr) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        full = self.expand(modname, call_name(value))
+        if full not in _LOCK_CTORS:
+            return None
+        kind = last_seg(full)
+        if kind != "Condition":
+            return kind
+        inner = value.args[0] if value.args else kwarg(value, "lock")
+        if inner is None:
+            return "Condition"   # stdlib default: RLock -> reentrant
+        if isinstance(inner, ast.Call) and self.expand(
+                modname, call_name(inner)) == "threading.Lock":
+            return "Condition(Lock)"
+        return "Condition"
+
+    def non_reentrant(self, lock_id: str) -> bool:
+        return self.locks.get(lock_id) in NON_REENTRANT_KINDS
+
+    def _build_types(self) -> None:
+        # 1. return annotations (independent of everything else)
+        for fi in self.functions.values():
+            if isinstance(fi.node, _FUNC_TYPES):
+                fi.returns = self._resolve_annotation(
+                    fi.modname, fi.node.returns)
+        # 2. module globals + module-level locks
+        for mod in self.project.modules:
+            modname = self.modname_of[id(mod)]
+            for stmt in mod.tree.body:
+                target = value = ann = None
+                if isinstance(stmt, ast.Assign) \
+                        and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    target, value = stmt.targets[0].id, stmt.value
+                elif isinstance(stmt, ast.AnnAssign) \
+                        and isinstance(stmt.target, ast.Name):
+                    target, value = stmt.target.id, stmt.value
+                    ann = stmt.annotation
+                if target is None:
+                    continue
+                kind = self._lock_kind(modname, value) \
+                    if value is not None else None
+                if kind is not None:
+                    lid = f"{modname}:{target}"
+                    self.locks[lid] = kind
+                    self.lock_sites[lid] = (mod, stmt.lineno)
+                    continue
+                t = self._resolve_annotation(modname, ann) \
+                    or (self._ctor_type(modname, value)
+                        if value is not None else None)
+                if t is not None:
+                    self.global_types[f"{modname}:{target}"] = t
+        # 3. class attribute types + class-attr locks
+        for ci in self.classes.values():
+            self._scan_class_attrs(ci)
+            for base in ci.node.bases:
+                r = self.resolve_symbol(ci.modname, dotted(base))
+                if r is not None and r[0] == "class":
+                    ci.bases.append(r[1])
+
+    def _ctor_type(self, modname: str,
+                   value: ast.expr) -> Optional[str]:
+        """Type of a no-context value expression: ``ClassName(...)`` or
+        ``factory(...)`` with an annotated return."""
+        if not isinstance(value, ast.Call):
+            return None
+        r = self.resolve_symbol(modname, call_name(value))
+        if r is None:
+            return None
+        kind, q = r
+        if kind == "class":
+            return q
+        fi = self.functions.get(q)
+        return fi.returns if fi is not None else None
+
+    def _scan_class_attrs(self, ci: ClassInfo) -> None:
+        for stmt in ci.node.body:           # class-body attrs
+            if isinstance(stmt, ast.Assign) \
+                    and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                self._class_attr(ci, stmt.targets[0].id, stmt.value,
+                                 None, stmt.lineno)
+            elif isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name):
+                self._class_attr(ci, stmt.target.id, stmt.value,
+                                 stmt.annotation, stmt.lineno)
+        for node in ast.walk(ci.node):      # self.<attr> = ... anywhere
+            targets: Sequence[ast.expr] = ()
+            value = ann = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign):
+                targets, value, ann = [node.target], node.value, \
+                    node.annotation
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    self._class_attr(ci, t.attr, value, ann,
+                                     node.lineno)
+
+    def _class_attr(self, ci: ClassInfo, attr: str,
+                    value: Optional[ast.expr],
+                    ann: Optional[ast.expr], lineno: int) -> None:
+        kind = self._lock_kind(ci.modname, value) \
+            if value is not None else None
+        if kind is not None:
+            lid = f"{ci.qname}.{attr}"
+            self.locks.setdefault(lid, kind)
+            self.lock_sites.setdefault(lid, (ci.module, lineno))
+            return
+        t = self._resolve_annotation(ci.modname, ann) \
+            or (self._ctor_type(ci.modname, value)
+                if value is not None else None)
+        if t is not None:
+            ci.attr_types.setdefault(attr, t)
+
+    # -- resolution ----------------------------------------------------
+
+    def resolve_symbol(self, modname: str, name: str
+                       ) -> Optional[Tuple[str, str]]:
+        """Resolve a dotted name used in ``modname`` to ("func", qname)
+        or ("class", qname), None when external/unresolvable."""
+        if not name:
+            return None
+        full = self.expand(modname, name)
+        pkg, sym = self.split_symbol(full)
+        if pkg is None or not sym:
+            # not a module path: try module-local symbols
+            pkg, sym = modname, name
+        segs = sym.split(".")
+        if len(segs) == 1:
+            q = f"{pkg}:{sym}"
+            if q in self.functions:
+                return ("func", q)
+            if q in self.classes:
+                return ("class", q)
+        elif len(segs) == 2:
+            q = f"{pkg}:{segs[0]}.{segs[1]}"
+            if q in self.functions:
+                return ("func", q)
+        return None
+
+    def find_method(self, class_qname: str, name: str,
+                    _depth: int = 0) -> Optional[str]:
+        ci = self.classes.get(class_qname)
+        if ci is None or _depth > 3:
+            return None
+        q = f"{class_qname}.{name}"
+        if q in self.functions:
+            return q
+        for base in ci.bases:
+            m = self.find_method(base, name, _depth + 1)
+            if m is not None:
+                return m
+        return None
+
+    def expr_type(self, modname: str, cls: Optional[str],
+                  env: Dict[str, str],
+                  expr: ast.expr) -> Optional[str]:
+        """Class qname of an expression's value, where statically
+        knowable; None otherwise."""
+        if isinstance(expr, ast.Call):
+            tgt = self.resolve_call_target(modname, cls, env, expr)
+            if tgt is not None:
+                q, _bound = tgt
+                if q.endswith(".__init__"):
+                    return q[: -len(".__init__")]
+                fi = self.functions.get(q)
+                return fi.returns if fi is not None else None
+            r = self.resolve_symbol(modname, call_name(expr))
+            if r is not None and r[0] == "class":
+                return r[1]     # class without an own __init__
+            return None
+        if isinstance(expr, ast.Name):
+            t = env.get(expr.id)
+            if t is not None:
+                return t
+            return self.global_types.get(f"{modname}:{expr.id}")
+        if isinstance(expr, ast.Attribute):
+            d = dotted(expr)
+            if d.startswith("self.") and cls is not None \
+                    and "." not in d[5:]:
+                ci = self.classes.get(f"{modname}:{cls}")
+                return ci.attr_types.get(d[5:]) if ci else None
+            full = self.expand(modname, d)
+            pkg, sym = self.split_symbol(full)
+            if pkg is not None and sym and "." not in sym:
+                return self.global_types.get(f"{pkg}:{sym}")
+        return None
+
+    def resolve_call_target(self, modname: str, cls: Optional[str],
+                            env: Dict[str, str], call: ast.Call
+                            ) -> Optional[Tuple[str, bool]]:
+        """The internal function a call targets, as (qname, bound) —
+        ``bound`` True when the receiver fills the ``self`` slot."""
+        f = call.func
+        if isinstance(f, ast.Attribute) \
+                and isinstance(f.value, ast.Call):
+            # chained factory: telemetry.get().event(...)
+            rt = self.expr_type(modname, cls, env, f.value)
+            if rt is not None:
+                m = self.find_method(rt, f.attr)
+                if m is not None:
+                    return (m, True)
+            return None
+        name = dotted(f)
+        if not name:
+            return None
+        root = root_seg(name)
+        rest = name[len(root) + 1:] if "." in name else ""
+        if root == "self" and cls is not None and rest:
+            return self._resolve_on_class(f"{modname}:{cls}", rest)
+        if rest:
+            recv_t = env.get(root) \
+                or self.global_types.get(f"{modname}:{root}")
+            if recv_t is not None:
+                return self._resolve_on_class(recv_t, rest)
+        r = self.resolve_symbol(modname, name)
+        if r is None:
+            return None
+        kind, q = r
+        if kind == "func":
+            # `Cls.meth(obj, …)` resolves unbound: args include self
+            fi = self.functions.get(q)
+            return (q, False if fi is not None and fi.cls is not None
+                    and "." in name else not (fi and fi.cls))
+        init = self.find_method(q, "__init__")
+        return (init, True) if init is not None else None
+
+    def _resolve_on_class(self, class_qname: str, rest: str
+                          ) -> Optional[Tuple[str, bool]]:
+        segs = rest.split(".")
+        if len(segs) == 1:
+            m = self.find_method(class_qname, segs[0])
+            return (m, True) if m is not None else None
+        if len(segs) == 2:
+            ci = self.classes.get(class_qname)
+            attr_t = ci.attr_types.get(segs[0]) if ci else None
+            if attr_t is not None:
+                m = self.find_method(attr_t, segs[1])
+                return (m, True) if m is not None else None
+        return None
+
+    def resolve_func_ref(self, modname: str, cls: Optional[str],
+                         env: Dict[str, str],
+                         expr: ast.expr) -> Optional[str]:
+        """A bare function REFERENCE (no call): ``self._handle``,
+        ``module.func`` — used for signal-handler targets."""
+        d = dotted(expr)
+        if not d:
+            return None
+        if d.startswith("self.") and cls is not None \
+                and "." not in d[5:]:
+            return self.find_method(f"{modname}:{cls}", d[5:])
+        r = self.resolve_symbol(modname, d)
+        if r is not None and r[0] == "func":
+            return r[1]
+        return None
+
+    def resolve_lock(self, modname: str, cls: Optional[str],
+                     env: Dict[str, str],
+                     expr: ast.expr) -> Optional[str]:
+        """A lock-acquisition receiver resolved to an inventory id:
+        ``self._lock``, ``_lineage_lock``, ``mod._lock``, or a typed
+        local's attribute."""
+        d = dotted(expr)
+        if not d:
+            return None
+        if d.startswith("self.") and cls is not None:
+            lid = f"{modname}:{cls}.{d[5:]}"
+            if lid in self.locks:
+                return lid
+            # the attribute may be inherited
+            ci = self.classes.get(f"{modname}:{cls}")
+            for base in (ci.bases if ci else ()):
+                lid = f"{base}.{d[5:]}"
+                if lid in self.locks:
+                    return lid
+            return None
+        if "." not in d:
+            lid = f"{modname}:{d}"
+            return lid if lid in self.locks else None
+        root, rest = d.split(".", 1)
+        recv_t = env.get(root) \
+            or self.global_types.get(f"{modname}:{root}")
+        if recv_t is not None:
+            lid = f"{recv_t}.{rest}"
+            return lid if lid in self.locks else None
+        full = self.expand(modname, d)
+        pkg, sym = self.split_symbol(full)
+        if pkg is not None and sym and "." not in sym:
+            lid = f"{pkg}:{sym}"
+            return lid if lid in self.locks else None
+        return None
+
+    # -- call graph ----------------------------------------------------
+
+    def _build_callgraph(self) -> None:
+        for mod in self.project.modules:
+            modname = self.modname_of[id(mod)]
+            scope = self.module_scopes[f"{modname}:<module>"]
+            self._walk(mod, modname, mod.tree, None, scope)
+
+    def _seed_env(self, fi: FuncInfo) -> None:
+        if not isinstance(fi.node, _FUNC_TYPES):
+            return
+        a = fi.node.args
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            t = self._resolve_annotation(fi.modname, p.annotation)
+            if t is not None:
+                fi.env.setdefault(p.arg, t)
+
+    def _walk(self, mod: Module, modname: str, node: ast.AST,
+              cls: Optional[str], fi: FuncInfo) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_TYPES):
+                q = self._func_of_node.get(id(child))
+                nfi = self.functions.get(q) if q is not None else None
+                if nfi is not None:
+                    self._seed_env(nfi)
+                    self._walk(mod, modname, child, nfi.cls, nfi)
+                else:
+                    # nested def: merge into the enclosing function
+                    self._walk(mod, modname, child, cls, fi)
+                continue
+            if isinstance(child, ast.ClassDef):
+                self._walk(mod, modname, child, child.name, fi)
+                continue
+            if isinstance(child, ast.Call):
+                self._record_call(mod, modname, cls, fi, child)
+            elif isinstance(child, ast.Assign) \
+                    and len(child.targets) == 1 \
+                    and isinstance(child.targets[0], ast.Name):
+                # walk the value first so chained calls resolve, then
+                # bind the local's type
+                self._walk(mod, modname, child, cls, fi)
+                t = self.expr_type(modname, cls, fi.env, child.value)
+                if t is not None:
+                    fi.env[child.targets[0].id] = t
+                continue
+            self._walk(mod, modname, child, cls, fi)
+
+    def _record_call(self, mod: Module, modname: str,
+                     cls: Optional[str], fi: FuncInfo,
+                     call: ast.Call) -> None:
+        caller = fi.qname
+        self.call_caller[id(call)] = caller
+        self.calls_of.setdefault(caller, []).append(call)
+        cn = call_name(call)
+        if (cn == "signal.signal" or cn.endswith(".signal.signal")) \
+                and len(call.args) >= 2:
+            h = self.resolve_func_ref(modname, cls, fi.env,
+                                      call.args[1])
+            if h is not None:
+                self.handlers.append((h, mod, call.lineno))
+        tgt = self.resolve_call_target(modname, cls, fi.env, call)
+        if tgt is not None:
+            q, bound = tgt
+            self.resolved[id(call)] = q
+            self.call_bound[id(call)] = bound
+            self.callees.setdefault(caller, set()).add(q)
+            self.call_sites.setdefault(q, []).append(
+                (caller, call, mod))
+        # recurse into receiver + arguments (nested calls)
+        self._walk(mod, modname, call, cls, fi)
+
+    # -- reachability --------------------------------------------------
+
+    def transitive_callees(self, qname: str) -> Set[str]:
+        cached = self._trans.get(qname)
+        if cached is not None:
+            return cached
+        seen: Set[str] = set()
+        stack = [qname]
+        while stack:
+            q = stack.pop()
+            for c in self.callees.get(q, ()):
+                if c not in seen:
+                    seen.add(c)
+                    stack.append(c)
+        self._trans[qname] = seen
+        return seen
+
+    def call_path(self, start: str, targets: Set[str]
+                  ) -> Optional[List[str]]:
+        """Shortest call-graph path from ``start`` to any of
+        ``targets`` (inclusive of both ends), for finding messages."""
+        if start in targets:
+            return [start]
+        prev: Dict[str, str] = {}
+        frontier = [start]
+        seen = {start}
+        while frontier:
+            nxt: List[str] = []
+            for q in frontier:
+                for c in sorted(self.callees.get(q, ())):
+                    if c in seen:
+                        continue
+                    seen.add(c)
+                    prev[c] = q
+                    if c in targets:
+                        path = [c]
+                        while path[-1] != start:
+                            path.append(prev[path[-1]])
+                        return list(reversed(path))
+                    nxt.append(c)
+            frontier = nxt
+        return None
+
+    def all_scopes(self) -> List[FuncInfo]:
+        """Every analyzable body: registered functions plus each
+        module's top-level scope."""
+        return list(self.functions.values()) \
+            + list(self.module_scopes.values())

@@ -242,6 +242,8 @@ class Config:
     # and an optional focused path list (empty = the full repo scope).
     lint_json: bool = False
     lint_paths: tuple = ()
+    lint_changed_only: bool = False   # findings only in git-changed files
+    lint_base: str = ""               # --changed-only diff base ref
     # Flight recorder (flightrec.py, ISSUE 7): a fixed-memory per-rank
     # ring buffer of per-step records (step/dispatch/data-wait times,
     # queue depth, retry/fault events) dumped to
@@ -855,6 +857,14 @@ def build_parser() -> argparse.ArgumentParser:
                         help="files/dirs to lint (default: repo scope)")
     p_lint.add_argument("--json", action="store_true",
                         help="machine-readable findings output")
+    p_lint.add_argument("--changed-only", action="store_true",
+                        help="report findings only in git-changed "
+                             "files; the whole program is still "
+                             "analyzed so interprocedural rules stay "
+                             "sound (whole-repo is the gate default)")
+    p_lint.add_argument("--base", default="", metavar="REF",
+                        help="with --changed-only: also include files "
+                             "changed since REF (git diff REF...HEAD)")
     return parser
 
 
@@ -890,7 +900,9 @@ def config_from_argv(argv=None) -> Config:
         return Config(action="incidents", rsl_path=args.rsl_path)
     if args.action == "lint":
         return Config(action="lint", lint_json=args.json,
-                      lint_paths=tuple(args.paths))
+                      lint_paths=tuple(args.paths),
+                      lint_changed_only=args.changed_only,
+                      lint_base=args.base)
     return Config(
         action=args.action,
         data_path=args.dataPath,

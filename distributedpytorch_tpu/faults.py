@@ -182,7 +182,12 @@ class FaultPlan:
         self.seed = int(seed)
         self._sites = frozenset(s.site for s in self.specs)
         self._hits: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        # REENTRANT on purpose: fire() is called from telemetry's write
+        # path, which the GracefulShutdown signal handler re-enters on
+        # the very thread that may already be inside fire() — a plain
+        # Lock self-deadlocks there (same class as the PR 12 preempt-
+        # handler bug; caught by graftlint lock-order-cycle).
+        self._lock = threading.RLock()
         self._rank: Optional[int] = None
 
     def targets(self, site: str) -> bool:

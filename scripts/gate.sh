@@ -90,7 +90,22 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== gate: graftlint static analysis =="
+# whole repo, all rules (not --changed-only: the gate is the place the
+# FULL interprocedural pass must hold), then assert the whole-program
+# rules are actually active — a refactor that silently drops them from
+# the catalog must fail here, not ship a weaker gate.
 python scripts/graftlint.py
+python scripts/graftlint.py --json > /tmp/graftlint_gate.json
+python - /tmp/graftlint_gate.json <<'PY'
+import json, sys
+payload = json.load(open(sys.argv[1]))
+missing = {"collective-divergence", "lock-order-cycle",
+           "mesh-axis-propagation"} - set(payload["rules"])
+assert not missing, f"whole-program rules inactive: {sorted(missing)}"
+assert payload["findings"] == [], payload["findings"]
+print(f"whole-program rules active ({len(payload['rules'])} total), "
+      f"repo clean")
+PY
 
 echo "== gate: ruff (generic lint baseline) =="
 if command -v ruff >/dev/null 2>&1; then

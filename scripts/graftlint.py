@@ -1,13 +1,21 @@
 #!/usr/bin/env python3
 """graftlint — JAX/TPU-aware static analysis for this repo.
 
-Static pass (default): the eight framework rules in
-distributedpytorch_tpu/analysis/rules.py over the package, entry
-points, bench harness and scripts.  Exit 0 = clean, 1 = findings.
+Static pass (default): the framework rule catalog in
+distributedpytorch_tpu/analysis/rules.py — per-file rules plus the
+whole-program analyses (collective-divergence, lock-order-cycle,
+mesh-axis-propagation) — over the package, entry points, bench harness
+and scripts.  Exit 0 = clean, 1 = findings.
 
     python scripts/graftlint.py            # human output
     python scripts/graftlint.py --json     # machine-readable
     python scripts/graftlint.py FILE...    # focused run
+    python scripts/graftlint.py --changed-only [--base REF]
+                                           # findings only in files git
+                                           # sees as changed; the whole
+                                           # program is still analyzed
+                                           # (whole-repo is the gate
+                                           # default)
     python main.py lint                    # equivalent in-CLI form
 
 Runtime sanitizer:
@@ -39,6 +47,13 @@ def main() -> int:
     p.add_argument("--smoke", action="store_true",
                    help="run the transfer-guard runtime smoke instead "
                         "of the static pass (forces JAX_PLATFORMS=cpu)")
+    p.add_argument("--changed-only", action="store_true",
+                   help="report findings only in git-changed files "
+                        "(whole program still loaded, so "
+                        "interprocedural rules stay sound)")
+    p.add_argument("--base", default=None, metavar="REF",
+                   help="with --changed-only: also include files "
+                        "changed since REF (git diff REF...HEAD)")
     args = p.parse_args()
     if args.smoke:
         from distributedpytorch_tpu.analysis.transfer_guard import \
@@ -47,7 +62,8 @@ def main() -> int:
     from distributedpytorch_tpu.analysis.core import run_cli
 
     return run_cli(json_output=args.json, paths=args.paths or None,
-                   root=_REPO_ROOT)
+                   root=_REPO_ROOT, changed_only=args.changed_only,
+                   base=args.base)
 
 
 if __name__ == "__main__":

@@ -265,3 +265,35 @@ def test_configure_installs_plan_and_policy(tmp_path):
         == (5, 0.01, 1.5, 9)
     faults.configure(None)  # re-invocation clears the plan
     assert faults.installed() is None
+
+
+# -- handler reentrancy ------------------------------------------------
+
+
+def test_plan_lock_is_reentrant_for_signal_handler_path():
+    """FaultPlan.fire runs under telemetry's write path, which the
+    GracefulShutdown signal handler re-enters ON THE SAME THREAD that
+    may already be inside fire() — with a plain Lock the second acquire
+    blocks forever (the PR 12 preempt-handler deadlock class, now
+    caught statically by graftlint's lock-order-cycle rule)."""
+    plan = faults.FaultPlan([], seed=0)
+    assert plan._lock.acquire(blocking=False)
+    try:
+        # same-thread re-acquire must succeed immediately (RLock);
+        # blocking=False keeps a regression a failure, not a hang
+        assert plan._lock.acquire(blocking=False), \
+            "FaultPlan._lock must be reentrant: the signal handler " \
+            "re-enters fire() on the interrupted thread"
+        plan._lock.release()
+    finally:
+        plan._lock.release()
+
+
+def test_fire_reachable_while_plan_lock_held_same_thread():
+    """End-to-end form: firing a site while the plan lock is already
+    held by this thread (as a mid-fire signal handler would) completes
+    instead of deadlocking."""
+    faults.install(faults.parse_plan("data.read:ioerror:99"))
+    plan = faults.installed()
+    with plan._lock:
+        assert faults.fire("data.read", path=None) is None  # hit 0 != 99

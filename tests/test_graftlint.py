@@ -1214,3 +1214,351 @@ def test_default_scope_covers_package_and_scripts():
     assert "distributedpytorch_tpu" in DEFAULT_SCOPE
     assert "scripts" in DEFAULT_SCOPE
     assert "bench.py" in DEFAULT_SCOPE
+
+
+# -- rule 17: collective-divergence (whole-program) --------------------
+
+_DIVERGENT_DIRECT = """
+    import jax
+
+    def reduce(x):
+        if jax.process_index() == 0:
+            return jax.lax.psum(x, "data")     # main-only: BAD
+        return x
+"""
+
+_DIVERGENT_LIB = """
+    import jax
+
+    def sync(x):
+        return jax.lax.psum(x, "data")
+"""
+
+_DIVERGENT_CALLER = """
+    from lib import sync
+
+    def run(x, rank):
+        if rank == 0:
+            sync(x)                            # reaches psum: BAD
+        return x
+"""
+
+_DIVERGENT_EARLY_EXIT = """
+    import jax
+
+    def save(x, is_main):
+        if not is_main():
+            return None
+        return jax.lax.psum(x, "data")         # only main gets here
+"""
+
+_UNIFORM_OK = """
+    import jax
+
+    def reduce(x):
+        if jax.process_count() > 1:            # same on every rank
+            return jax.lax.psum(x, "data")
+        return x
+"""
+
+_DIVERGENT_SUPPRESSED = """
+    import jax
+
+    def publish(x):
+        if jax.process_index() == 0:
+            # graftlint: disable=collective-divergence -- followers are parked polling a file, never in this collective
+            return jax.lax.psum(x, "data")
+        return x
+"""
+
+
+def test_collective_divergence_direct_positive(tmp_path):
+    found = _lint(tmp_path, {"engine.py": _DIVERGENT_DIRECT},
+                  rule="collective-divergence")
+    assert len(found) == 1
+    assert "process_index" in found[0].message
+    assert "hang" in found[0].message
+
+
+def test_collective_divergence_transitive_cross_file(tmp_path):
+    found = _lint(tmp_path, {"lib.py": _DIVERGENT_LIB,
+                             "caller.py": _DIVERGENT_CALLER},
+                  rule="collective-divergence")
+    assert [f for f in found if f.path.endswith("caller.py")]
+    assert "psum" in found[0].message  # names the reached collective
+
+
+def test_collective_divergence_early_exit_positive(tmp_path):
+    found = _lint(tmp_path, {"engine.py": _DIVERGENT_EARLY_EXIT},
+                  rule="collective-divergence")
+    assert len(found) == 1
+    assert "early exit" in found[0].message
+
+
+def test_collective_divergence_uniform_condition_negative(tmp_path):
+    assert _lint(tmp_path, {"engine.py": _UNIFORM_OK},
+                 rule="collective-divergence") == []
+
+
+def test_collective_divergence_suppression_with_rationale(tmp_path):
+    assert _lint(tmp_path, {"engine.py": _DIVERGENT_SUPPRESSED},
+                 rule="collective-divergence") == []
+
+
+# -- rule 18: lock-order-cycle (whole-program) -------------------------
+
+_TWO_LOCK_CYCLE = """
+    import threading
+
+    _a = threading.Lock()
+    _b = threading.Lock()
+
+    def one():
+        with _a:
+            with _b:
+                pass
+
+    def two():
+        with _b:
+            with _a:
+                pass
+"""
+
+_THREE_LOCK_A = """
+    import threading
+    from libc import grab_c
+
+    _a = threading.Lock()
+    _b = threading.Lock()
+
+    def ab(x):
+        with _a:
+            with _b:
+                return x
+
+    def bc(x):
+        with _b:
+            return grab_c(x)               # edge b -> c through a call
+"""
+
+_THREE_LOCK_C = """
+    import threading
+    from liba import ab
+
+    _c = threading.Lock()
+
+    def grab_c(x):
+        with _c:
+            return x
+
+    def ca(x):
+        with _c:
+            return ab(x)                   # edge c -> a: closes cycle
+"""
+
+_HANDLER_LOCK_BAD = """
+    import signal
+    import threading
+
+    _log_lock = threading.Lock()
+
+    def log(msg):
+        with _log_lock:
+            pass
+
+    def _handle(signum, frame):
+        log("preempted")                   # handler -> Lock: BAD
+
+    def install():
+        signal.signal(signal.SIGTERM, _handle)
+"""
+
+_HANDLER_RLOCK_OK = _HANDLER_LOCK_BAD.replace("threading.Lock()",
+                                              "threading.RLock()")
+
+_SELF_DEADLOCK = """
+    import threading
+
+    _lock = threading.Lock()
+
+    def log(msg):
+        with _lock:
+            pass
+
+    def flush():
+        with _lock:
+            log("flush")                   # re-acquires _lock: BAD
+"""
+
+_NESTED_ORDERED_OK = """
+    import threading
+
+    _a = threading.Lock()
+    _b = threading.Lock()
+
+    def one():
+        with _a:
+            with _b:
+                pass
+
+    def two():
+        with _a:                           # same global order: fine
+            with _b:
+                pass
+"""
+
+
+def test_lock_order_two_lock_cycle_positive(tmp_path):
+    found = _lint(tmp_path, {"locks.py": _TWO_LOCK_CYCLE},
+                  rule="lock-order-cycle")
+    assert found
+    assert "cycle" in found[0].message
+    assert "_a" in found[0].message and "_b" in found[0].message
+
+
+def test_lock_order_three_lock_cycle_through_call(tmp_path):
+    found = _lint(tmp_path, {"liba.py": _THREE_LOCK_A,
+                             "libc.py": _THREE_LOCK_C},
+                  rule="lock-order-cycle")
+    assert any("cycle" in f.message for f in found)
+
+
+def test_lock_order_consistent_order_negative(tmp_path):
+    assert _lint(tmp_path, {"locks.py": _NESTED_ORDERED_OK},
+                 rule="lock-order-cycle") == []
+
+
+def test_handler_acquires_plain_lock_positive(tmp_path):
+    """The PR 12 preempt-handler deadlock, reconstructed: a signal
+    handler whose call chain takes a non-reentrant Lock."""
+    found = _lint(tmp_path, {"shutdown.py": _HANDLER_LOCK_BAD},
+                  rule="lock-order-cycle")
+    assert len(found) == 1
+    assert "signal handler" in found[0].message
+    assert "_handle" in found[0].message
+    assert "log" in found[0].message       # names the chain
+    assert "RLock" in found[0].message     # and the fix
+
+
+def test_handler_acquires_rlock_negative(tmp_path):
+    assert _lint(tmp_path, {"shutdown.py": _HANDLER_RLOCK_OK},
+                 rule="lock-order-cycle") == []
+
+
+def test_lock_reacquired_through_call_positive(tmp_path):
+    found = _lint(tmp_path, {"locks.py": _SELF_DEADLOCK},
+                  rule="lock-order-cycle")
+    assert len(found) == 1
+    assert "re-acquired" in found[0].message
+
+
+# -- rule 19: mesh-axis-propagation (whole-program) --------------------
+
+_AXIS_LIB = """
+    import jax
+
+    DATA_AXIS = "data"
+
+    def reduce_mean(x, axis_name="data"):
+        return jax.lax.pmean(x, axis_name)
+"""
+
+_AXIS_CALLER_BAD = """
+    from lib import reduce_mean
+
+    def run(x):
+        return reduce_mean(x, axis_name="dtaa")   # typo: BAD
+"""
+
+_AXIS_CALLER_OK = """
+    from lib import reduce_mean, DATA_AXIS
+
+    def run(x):
+        a = reduce_mean(x, axis_name="data")
+        b = reduce_mean(x, axis_name=DATA_AXIS)
+        c = reduce_mean(x)                        # default: rule 3's job
+        return a, b, c
+"""
+
+
+def test_mesh_axis_cross_file_mismatch_positive(tmp_path):
+    found = _lint(tmp_path, {"lib.py": _AXIS_LIB,
+                             "caller.py": _AXIS_CALLER_BAD},
+                  rule="mesh-axis-propagation")
+    assert len(found) == 1
+    assert found[0].path.endswith("caller.py")    # flagged at the SITE
+    assert "'dtaa'" in found[0].message
+    assert "pmean" in found[0].message
+
+
+def test_mesh_axis_cross_file_clean_negative(tmp_path):
+    assert _lint(tmp_path, {"lib.py": _AXIS_LIB,
+                            "caller.py": _AXIS_CALLER_OK},
+                 rule="mesh-axis-propagation") == []
+
+
+# -- whole-program CLI contract ----------------------------------------
+
+def test_json_output_lists_active_rules(tmp_path, capsys):
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    rc = run_cli(json_output=True, paths=[str(tmp_path)],
+                 root=str(tmp_path))
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    for name in ("collective-divergence", "lock-order-cycle",
+                 "mesh-axis-propagation", "host-sync-in-step-loop",
+                 "bad-suppression"):
+        assert name in payload["rules"]
+
+
+def test_changed_only_filters_to_git_changed_files(tmp_path, capsys):
+    import subprocess
+
+    def git(*argv):
+        subprocess.run(["git", "-c", "user.name=t",
+                        "-c", "user.email=t@t"] + list(argv),
+                       cwd=str(tmp_path), check=True,
+                       capture_output=True)
+
+    # committed bad file (unchanged) + freshly added bad file
+    (tmp_path / "cli.py").write_text(textwrap.dedent(_STEP_LOOP_BAD))
+    git("init")
+    git("add", "cli.py")
+    git("commit", "-m", "seed")
+    (tmp_path / "engine.py").write_text(
+        textwrap.dedent(_DIVERGENT_DIRECT))
+
+    rc = run_cli(json_output=True, paths=[str(tmp_path)],
+                 root=str(tmp_path), changed_only=True)
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["changed_only"] is True
+    flagged = {f["path"] for f in payload["findings"]}
+    assert any(p.endswith("engine.py") for p in flagged)
+    assert not any(p.endswith("cli.py") for p in flagged), \
+        "unchanged files must be filtered from --changed-only output"
+
+
+def test_changed_only_outside_git_is_usage_error(tmp_path, capsys):
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    rc = run_cli(paths=[str(tmp_path)], root=str(tmp_path),
+                 changed_only=True)
+    assert rc == 2  # fail loudly, never silently lint nothing
+
+
+def test_full_repo_lint_runtime_budget(capsys):
+    """The whole-program build is paid ONCE per invocation (memoized on
+    Project) and every per-file rule shares one cached AST index per
+    module — the full ~80-file repo pass stays interactive.  Budgeted
+    in CPU time (the pass is single-threaded) so a loaded CI box can't
+    flake the test: typical is ~2.5s; the ceiling is generous, while a
+    regression to per-rule re-traversal (~9s measured before the
+    shared index) still fails."""
+    import time
+
+    t0 = time.process_time()
+    rc = run_cli(root=REPO)
+    dt = time.process_time() - t0
+    capsys.readouterr()
+    assert rc == 0
+    assert dt < 6.0, f"full-repo lint took {dt:.2f}s CPU (budget 6.0s)"

@@ -1,0 +1,137 @@
+"""Whole-program core (analysis/wholeprogram.py): symbol table, call
+graph, lock inventory, and handler registry pinned on a small fixture
+package — the resolution layer rules 17-19 stand on.
+
+The fixture exercises the repo's real idioms: ``from x import y`` at
+module level, function-local imports, factory functions with return
+annotations (``def get() -> Tracer``), annotated module globals,
+method calls on ``self`` and on typed locals, and ``signal.signal``
+registration of a bound method.
+"""
+
+import textwrap
+
+from distributedpytorch_tpu.analysis.core import lint_paths, load_project
+from distributedpytorch_tpu.analysis.wholeprogram import (WholeProgram,
+                                                          display,
+                                                          module_name)
+
+_UTIL = """
+    import threading
+
+    _lock = threading.Lock()
+    _rlock = threading.RLock()
+
+    def helper(x):
+        return x + 1
+
+    class Sink:
+        def __init__(self):
+            self._buf = []
+            self._cond = threading.Condition(threading.Lock())
+
+        def write(self, item):
+            with _lock:
+                self._buf.append(item)
+
+        def flush(self):
+            self.write(None)
+
+    def get() -> Sink:
+        return Sink()
+"""
+
+_APP = """
+    import signal
+    from util import get, helper
+    from util import Sink
+
+    _sink: Sink = None
+
+    def work(x):
+        y = helper(x)
+        s = get()
+        s.flush()                  # typed local -> Sink.flush
+        get().write(y)             # chained factory -> Sink.write
+        return y
+
+    class Shutdown:
+        def _handle(self, signum, frame):
+            work(0)
+
+        def install(self):
+            signal.signal(signal.SIGTERM, self._handle)
+"""
+
+
+def _build(tmp_path):
+    for name, src in (("util.py", _UTIL), ("app.py", _APP)):
+        (tmp_path / name).write_text(textwrap.dedent(src))
+    project, findings = load_project([str(tmp_path)],
+                                     root=str(tmp_path))
+    assert findings == []
+    return WholeProgram(project)
+
+
+def test_module_name_mapping():
+    assert module_name("distributedpytorch_tpu/faults.py") \
+        == "distributedpytorch_tpu.faults"
+    assert module_name("distributedpytorch_tpu/analysis/__init__.py") \
+        == "distributedpytorch_tpu.analysis"
+    assert module_name("main.py") == "main"
+
+
+def test_import_and_method_resolution(tmp_path):
+    wp = _build(tmp_path)
+    callees = wp.callees.get("app:work", set())
+    assert "util:helper" in callees          # from util import helper
+    assert "util:Sink.flush" in callees      # typed local
+    assert "util:Sink.write" in callees      # chained factory call
+
+
+def test_transitive_closure_crosses_methods(tmp_path):
+    wp = _build(tmp_path)
+    # work -> flush -> write: write reachable transitively
+    assert "util:Sink.write" in wp.transitive_callees("app:work")
+    # handler -> work -> ... -> write
+    assert "util:Sink.write" \
+        in wp.transitive_callees("app:Shutdown._handle")
+
+
+def test_lock_inventory_kinds_and_reentrancy(tmp_path):
+    wp = _build(tmp_path)
+    assert wp.locks["util:_lock"] == "Lock"
+    assert wp.locks["util:_rlock"] == "RLock"
+    assert wp.locks["util:Sink._cond"] == "Condition(Lock)"
+    assert wp.non_reentrant("util:_lock")
+    assert wp.non_reentrant("util:Sink._cond")
+    assert not wp.non_reentrant("util:_rlock")
+
+
+def test_signal_handler_registry(tmp_path):
+    wp = _build(tmp_path)
+    assert [h for h, _mod, _line in wp.handlers] \
+        == ["app:Shutdown._handle"]
+
+
+def test_call_path_names_the_chain(tmp_path):
+    wp = _build(tmp_path)
+    path = wp.call_path("app:Shutdown._handle", {"util:Sink.write"})
+    assert path[0] == "app:Shutdown._handle"
+    assert path[-1] == "util:Sink.write"
+
+
+def test_display_strips_package_prefix():
+    assert display("distributedpytorch_tpu.faults:FaultPlan.fire") \
+        == "faults.FaultPlan.fire"
+
+
+def test_fixture_package_flags_handler_lock(tmp_path):
+    """End to end: the fixture's handler reaches util._lock through
+    work -> Sink.write, and rule 18 reports it."""
+    for name, src in (("util.py", _UTIL), ("app.py", _APP)):
+        (tmp_path / name).write_text(textwrap.dedent(src))
+    findings, _ = lint_paths([str(tmp_path)], root=str(tmp_path))
+    msgs = [f.message for f in findings
+            if f.rule == "lock-order-cycle"]
+    assert any("signal handler" in m and "_lock" in m for m in msgs)
