@@ -2059,6 +2059,98 @@ class MeshAxisPropagation(Rule):
                         f"reach this call chain")
 
 
+# -- 20. outbound-call-without-timeout --------------------------------
+
+class OutboundCallWithoutTimeout(Rule):
+    """Control-plane code (the fleet collector, the front door, the
+    rollout/autoscale loops) lives or dies by bounded outbound calls: a
+    single hung replica socket with no timeout freezes the whole
+    control loop — probes stop, admission stops shedding, the
+    autoscaler stops repairing, and the one stuck upstream takes the
+    fleet's brain down with it (ISSUE 19 satellite; deadline.py is the
+    repo's sanctioned wrapper).  In serving/fleet/controller modules,
+    three stdlib escape hatches are findings when no timeout reaches
+    them:
+
+      * ``urllib.request.urlopen(url)`` without ``timeout=`` — the
+        stdlib default is the GLOBAL socket default, i.e. block forever;
+      * ``http.client.HTTPConnection(...)`` / ``HTTPSConnection(...)``
+        without a timeout (kwarg or 3rd positional);
+      * ``socket.create_connection(addr)`` without a timeout (kwarg or
+        2nd positional).
+
+    A ``timeout`` that is present but a literal ``None`` still counts —
+    that is the block-forever spelling.  Deliberate exceptions carry a
+    rationale comment on the line or the line above (same contract as
+    wall-clock-in-measurement)."""
+
+    name = "outbound-call-without-timeout"
+    description = ("urlopen()/HTTPConnection()/create_connection() "
+                   "without a timeout in serving/fleet/controller "
+                   "code — one hung upstream must never freeze the "
+                   "control loop; bound every outbound call (see "
+                   "deadline.py)")
+    TARGET_BASENAMES = {"fleet.py", "deadline.py", "frontdoor.py",
+                        "controller.py", "rollout.py"}
+
+    _has_rationale = BlockingH2dInStepLoop._has_rationale
+
+    def _targets(self, mod: Module) -> bool:
+        return (mod.basename in self.TARGET_BASENAMES
+                or "serving" in mod.rel.replace("\\", "/").split("/")[:-1])
+
+    @staticmethod
+    def _timeout_arg(call: ast.Call, pos: int) -> Optional[ast.AST]:
+        arg = kwarg(call, "timeout")
+        if arg is None and len(call.args) > pos:
+            arg = call.args[pos]
+        return arg
+
+    def _unbounded(self, call: ast.Call) -> Optional[str]:
+        """The offending callable's name, or None when the call either
+        is not an outbound ctor or carries a real timeout."""
+        cn = call_name(call)
+        last, root = last_seg(cn), root_seg(cn)
+        if root == last:
+            root = ""  # bare from-import: urlopen(...), HTTPConnection(...)
+        if last == "urlopen" and root in ("urllib", "request", "", "dl"):
+            pos = 99  # urlopen's timeout is keyword-position 3; treat
+            # positional use as absent — nobody threads data/cafile
+        elif last in ("HTTPConnection", "HTTPSConnection") \
+                and root in ("http", "client", ""):
+            pos = 2  # HTTPConnection(host, port, timeout)
+        elif last == "create_connection" and root in ("socket", ""):
+            pos = 1  # create_connection(address, timeout)
+        else:
+            return None
+        arg = self._timeout_arg(call, pos)
+        if arg is None or (isinstance(arg, ast.Constant)
+                           and arg.value is None):
+            return cn
+        return None
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            if not self._targets(mod):
+                continue
+            for node in mod.index.nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                cn = self._unbounded(node)
+                if cn is None:
+                    continue
+                if self._has_rationale(mod, node.lineno):
+                    continue
+                yield self.finding(
+                    mod, node.lineno,
+                    f"{cn}() without a timeout in control-plane code: "
+                    f"the stdlib default blocks forever, so one hung "
+                    f"upstream freezes probes, shedding and "
+                    f"autoscaling fleet-wide — pass timeout= (or use "
+                    f"deadline.fetch/post_json), or comment why this "
+                    f"call is bounded elsewhere")
+
+
 RULES = (
     HostSyncInStepLoop(),
     TraceImpurity(),
@@ -2079,6 +2171,7 @@ RULES = (
     CollectiveDivergence(),
     LockOrderCycle(),
     MeshAxisPropagation(),
+    OutboundCallWithoutTimeout(),
 )
 
 RULES_BY_NAME = {r.name: r for r in RULES}

@@ -258,6 +258,40 @@ def verify_checkpoint(path: str) -> Optional[str]:
     return None
 
 
+def lineage_info(path: str) -> Optional[dict]:
+    """The served-model identity for ``path``: ``{"file", "path",
+    "sha256", "epoch"}`` (ISSUE 19 satellite).  The sha comes from the
+    lineage ledger when recorded, else is computed from the content
+    (pre-lineage files still get an identity); orbax directories use
+    their meta.json structural checksum.  Surfaced on the serving
+    tier's /livez + /healthz and stamped into trace records, so the
+    front door and the canary verdict can see WHICH checkpoint each
+    replica actually runs.  None only when the path is unreadable."""
+    path = os.path.abspath(path)
+    name = os.path.basename(path.rstrip(os.sep))
+    if os.path.isdir(path):
+        try:
+            with open(os.path.join(path, _ORBAX_META)) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if not isinstance(meta, dict):
+            return None
+        sha = meta.get("checksum") or _orbax_checksum(path)
+        return {"file": name, "path": path, "sha256": sha,
+                "epoch": meta.get("epoch")}
+    rec = _lineage_entry(path)
+    if rec is not None and rec.get("sha256"):
+        return {"file": name, "path": path,
+                "sha256": rec["sha256"], "epoch": rec.get("epoch")}
+    try:
+        with open(path, "rb") as f:
+            sha = hashlib.sha256(f.read()).hexdigest()
+    except OSError:
+        return None
+    return {"file": name, "path": path, "sha256": sha, "epoch": None}
+
+
 def list_checkpoints(rsl_path: str, dataset: str,
                      model_name: str) -> List[str]:
     """Rolling checkpoint paths for (dataset, model) under ``rsl_path``,

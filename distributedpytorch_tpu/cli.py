@@ -1558,6 +1558,12 @@ def run_serve(cfg: Config) -> dict:
     # lifetime: ranks renumber at every reconfigure, and a port that
     # moved with them would break every client mid-incident.
     port = cfg.serve_port + runtime.process_index()
+    # Accepted connections on the replica's own listeners must survive
+    # the elastic park's stale-socket sweep, or every in-flight request
+    # dies at each reconfigure.
+    elastic.register_app_ports(
+        port, (cfg.metrics_port + runtime.process_index())
+        if cfg.metrics_port else 0)
     tel.event("run_start", action="serve", dataset=cfg.dataset,
               world=runtime.world_size(),
               processes=runtime.process_count(),
@@ -1594,6 +1600,38 @@ def run_serve(cfg: Config) -> dict:
                 port=port,
                 request_timeout_s=cfg.serve_request_timeout,
                 max_requests=cfg.serve_max_requests)
+            # Served-model identity (ISSUE 19): the lineage sha rides
+            # /livez, the exporter /healthz serve block, and every
+            # trace record — what the front door's canary verdict
+            # compares.  current_ckpt tracks hot-swaps so an elastic
+            # rebuild re-restores what is actually being served.
+            current_ckpt = [cfg.checkpoint_file]
+            tier.set_checkpoint(ckpt.lineage_info(cfg.checkpoint_file))
+            tracing.get().set_lineage(
+                (tier.checkpoint or {}).get("sha256"))
+
+            def swap_fn(path):
+                # the /admin/reload seam: lineage-verify, rebuild the
+                # predict closure (restore_for_serving + AOT warmup),
+                # hand it back to the driver loop
+                new_name = ckpt.get_checkpoint_model_name(path)
+                if new_name != model_name:
+                    raise ValueError(
+                        f"checkpoint {path!r} holds model "
+                        f"{new_name!r}; this replica serves "
+                        f"{model_name!r}")
+                reason = ckpt.verify_checkpoint(path)
+                if reason is not None:
+                    raise ValueError(
+                        f"lineage verification failed for {path!r}: "
+                        f"{reason}")
+                new_infer = _serve_build_replica(
+                    cfg.replace(checkpoint_file=path), model_name,
+                    dataset, buckets, sample_shape, sample_dtype)
+                current_ckpt[0] = path
+                return new_infer, ckpt.lineage_info(path)
+
+            tier.set_swap_fn(swap_fn)
             goodput.set_health_extra(tier.stats)
             tier.start()
 
@@ -1638,10 +1676,12 @@ def run_serve(cfg: Config) -> dict:
                 with goodput.get().timed("elastic_reconfigure"):
                     _elastic_reconfigure(cfg, tel, None, grow,
                                          purpose="serve")
-                    infer = _serve_build_replica(cfg, model_name,
-                                                 dataset, buckets,
-                                                 sample_shape,
-                                                 sample_dtype)
+                    # rebuild what is actually served — a hot-swapped
+                    # replica must not silently revert on reconfigure
+                    infer = _serve_build_replica(
+                        cfg.replace(checkpoint_file=current_ckpt[0]),
+                        model_name, dataset, buckets, sample_shape,
+                        sample_dtype)
                     tier.set_infer(infer)
                 logging.info(
                     f"serve: replica rebuilt for generation "
@@ -1726,6 +1766,14 @@ def main(argv=None) -> int:
         from . import fleet
 
         return fleet.run_cli(cfg)
+    if cfg.action == "frontdoor":
+        # The fleet front door (serving/frontdoor.py): one client port
+        # over many replicas — health-aware routing, SLO-driven
+        # autoscale, canary rollout.  A control-plane process, never a
+        # member of the world, no JAX backend touched.
+        from .serving import frontdoor
+
+        return frontdoor.run_cli(cfg)
     if cfg.action == "incidents":
         # Offline digest of the incident bundles a fleet run wrote.
         from . import slo

@@ -315,6 +315,48 @@ class Config:
     fleet_stale_after: int = 3
     fleet_max_cycles: int = 0
     slo_spec: Optional[str] = None
+    # 'frontdoor' subcommand (serving/frontdoor.py, ISSUE 19): one
+    # client port over fd_ranks serve replicas (predict at serve_port+i,
+    # health at metrics_port+i /healthz — or /livez on the serve port
+    # when no exporter).  Health-aware routing ejects a replica after
+    # fd_eject_after consecutive probe failures (or a last_step_age_s
+    # above fd_max_step_age; 0 disables the staleness check) and
+    # readmits it on recovery; admission sheds with a 503 + Retry-After
+    # once fd_pending_budget in-flight requests are queued fleet-wide.
+    # --autoscale turns on the controller (queue/shed/SLO-verdict
+    # pressure -> launch via --launch-cmd; calm -> graceful drain),
+    # clamped to [fd_min_world, fd_max_world or fd_ranks] with
+    # hysteresis (fd_up_hold/fd_down_hold/fd_cooldown).  --rollout
+    # watches fd_watch_dir (default RSL_PATH) for a newer
+    # lineage-verified checkpoint and canaries it on a fd_canary_*
+    # fraction of replicas, promoting or rolling back on the
+    # canary-vs-stable error-rate/p95 comparison.  fd_max_cycles bounds
+    # the control loop for gates (0 = run until ^C).
+    fd_port: int = 8080
+    fd_ranks: int = 1
+    fd_min_world: int = 1
+    fd_max_world: int = 0
+    fd_interval: float = 0.5
+    fd_upstream_timeout: float = 10.0
+    fd_pending_budget: int = 64
+    fd_retry_after: float = 1.0
+    fd_eject_after: int = 3
+    fd_max_step_age: float = 0.0
+    fd_max_cycles: int = 0
+    fd_autoscale: bool = False
+    fd_queue_high: float = 8.0
+    fd_queue_low: float = 1.0
+    fd_up_hold: float = 2.0
+    fd_down_hold: float = 10.0
+    fd_cooldown: float = 5.0
+    fd_launch_cmd: Optional[str] = None
+    fd_rollout: bool = False
+    fd_watch_dir: Optional[str] = None
+    fd_canary_fraction: float = 0.34
+    fd_canary_hold: float = 5.0
+    fd_canary_min_requests: int = 20
+    fd_canary_max_error: float = 0.05
+    fd_canary_p95_factor: float = 3.0
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -840,6 +882,150 @@ def build_parser() -> argparse.ArgumentParser:
                               "(slo.py schema); firing objectives "
                               "write incident-*.json bundles")
 
+    # Fleet front door (serving/frontdoor.py, ISSUE 19) — a standalone
+    # control-plane process, no JAX backend: one client port, health-
+    # aware routing over the serve replicas, SLO-driven autoscale,
+    # canary rollout with automatic rollback.
+    p_fd = sub.add_parser(
+        "frontdoor", help="run the fleet front door: route client "
+                          "/predict traffic across the serve replicas "
+                          "with health-aware admission, autoscale on "
+                          "queue/SLO pressure, and canary-roll out "
+                          "newer lineage-verified checkpoints")
+    p_fd.add_argument("--rsl_path", type=str, default=RSL_PATH,
+                      help=f"run directory shared with the serve "
+                           f"world: telemetry events and join logs "
+                           f"land here (default: {RSL_PATH})")
+    p_fd.add_argument("--port", type=int, default=8080,
+                      dest="fdPort", metavar="PORT",
+                      help="the one client-facing port (default 8080)")
+    p_fd.add_argument("--ranks", type=int, default=1,
+                      dest="fdRanks", metavar="N",
+                      help="initial replica count: predict ports "
+                           "serve-port..serve-port+N-1 (default 1)")
+    p_fd.add_argument("--serve-port", type=int, default=8100,
+                      dest="servePort", metavar="PORT",
+                      help="base /predict port of the replicas "
+                           "(replica i answers on PORT + i; "
+                           "default 8100)")
+    p_fd.add_argument("--metrics-port", type=int, default=0,
+                      dest="metricsPort", metavar="PORT",
+                      help="base port of the per-rank exporters: "
+                           "health probes hit PORT + i /healthz and "
+                           "the embedded fleet collector scrapes "
+                           "them (0 = probe /livez on the predict "
+                           "port instead, no collector; default 0)")
+    p_fd.add_argument("--interval", type=float, default=0.5,
+                      dest="fdInterval", metavar="S",
+                      help="control-loop period: probe + scrape + "
+                           "autoscale/rollout decisions (default 0.5)")
+    p_fd.add_argument("--upstream-timeout", type=float, default=10.0,
+                      dest="fdUpstreamTimeout", metavar="S",
+                      help="per-attempt deadline on a proxied "
+                           "/predict; a hung replica is cut off and "
+                           "the request retried once on another "
+                           "(default 10.0)")
+    p_fd.add_argument("--pending-budget", type=int, default=64,
+                      dest="fdPendingBudget", metavar="N",
+                      help="fleet-wide in-flight request budget past "
+                           "which admission sheds with 503 + "
+                           "Retry-After (default 64)")
+    p_fd.add_argument("--retry-after", type=float, default=1.0,
+                      dest="fdRetryAfter", metavar="S",
+                      help="Retry-After hint on shed responses "
+                           "(default 1.0)")
+    p_fd.add_argument("--eject-after", type=int, default=3,
+                      dest="fdEjectAfter", metavar="N",
+                      help="consecutive probe/transport failures "
+                           "before a replica is ejected from routing "
+                           "(readmitted on recovery; default 3)")
+    p_fd.add_argument("--max-step-age", type=float, default=0.0,
+                      dest="fdMaxStepAge", metavar="S",
+                      help="eject a replica whose /healthz "
+                           "last_step_age_s exceeds S (0 disables "
+                           "the staleness check; default 0)")
+    p_fd.add_argument("--max-cycles", type=int, default=0,
+                      dest="fdMaxCycles", metavar="N",
+                      help="stop after N control cycles (0 = run "
+                           "until interrupted; gates use N)")
+    p_fd.add_argument("--slo-spec", type=str, default=None,
+                      dest="sloSpec", metavar="FILE",
+                      help="SLO objectives (slo.py schema) evaluated "
+                           "by the embedded collector; firing "
+                           "verdicts are scale-up pressure")
+    p_fd.add_argument("--stale-after", type=int, default=3,
+                      dest="fleetStaleAfter", metavar="N",
+                      help="collector scrapes before a silent rank "
+                           "ages out of the merged series (default 3)")
+    p_fd.add_argument("--autoscale", action="store_true",
+                      dest="fdAutoscale",
+                      help="enable the autoscale controller")
+    p_fd.add_argument("--min-world", type=int, default=1,
+                      dest="fdMinWorld", metavar="N",
+                      help="never drain below N replicas; a world "
+                           "below N is repaired by launching "
+                           "(default 1)")
+    p_fd.add_argument("--max-world", type=int, default=0,
+                      dest="fdMaxWorld", metavar="N",
+                      help="never launch above N replicas (0 = "
+                           "--ranks; default 0)")
+    p_fd.add_argument("--queue-high", type=float, default=8.0,
+                      dest="fdQueueHigh", metavar="D",
+                      help="scale up when every replica's queue depth "
+                           "holds at/above D (default 8.0)")
+    p_fd.add_argument("--queue-low", type=float, default=1.0,
+                      dest="fdQueueLow", metavar="D",
+                      help="scale down only when every queue depth "
+                           "holds at/below D (default 1.0)")
+    p_fd.add_argument("--up-hold", type=float, default=2.0,
+                      dest="fdUpHold", metavar="S",
+                      help="pressure must hold S seconds before a "
+                           "scale-up (default 2.0)")
+    p_fd.add_argument("--down-hold", type=float, default=10.0,
+                      dest="fdDownHold", metavar="S",
+                      help="calm must hold S seconds before a "
+                           "scale-down (default 10.0)")
+    p_fd.add_argument("--cooldown", type=float, default=5.0,
+                      dest="fdCooldown", metavar="S",
+                      help="minimum spacing between scale actions "
+                           "(default 5.0)")
+    p_fd.add_argument("--launch-cmd", type=str, default=None,
+                      dest="fdLaunchCmd", metavar="CMD",
+                      help="shell-ish command launched (Popen, no "
+                           "shell) to add a replica on scale-up — "
+                           "typically main.py serve --elastic-join")
+    p_fd.add_argument("--rollout", action="store_true",
+                      dest="fdRollout",
+                      help="enable canary rollout of newer "
+                           "lineage-verified checkpoints")
+    p_fd.add_argument("--watch-dir", type=str, default=None,
+                      dest="fdWatchDir", metavar="DIR",
+                      help="directory whose ckpt-lineage.json is "
+                           "watched for new checkpoints (default: "
+                           "rsl_path)")
+    p_fd.add_argument("--canary-fraction", type=float, default=0.34,
+                      dest="fdCanaryFraction", metavar="F",
+                      help="fraction of routable replicas given the "
+                           "candidate (always >=1, never all; "
+                           "default 0.34)")
+    p_fd.add_argument("--canary-hold", type=float, default=5.0,
+                      dest="fdCanaryHold", metavar="S",
+                      help="canary soak time before promotion "
+                           "(default 5.0)")
+    p_fd.add_argument("--canary-min-requests", type=int, default=20,
+                      dest="fdCanaryMinRequests", metavar="N",
+                      help="canary answers required before a "
+                           "promote/rollback verdict (default 20)")
+    p_fd.add_argument("--canary-max-error", type=float, default=0.05,
+                      dest="fdCanaryMaxError", metavar="R",
+                      help="canary error ratio above which (and above "
+                           "stable's) the candidate is rolled back "
+                           "(default 0.05)")
+    p_fd.add_argument("--canary-p95-factor", type=float, default=3.0,
+                      dest="fdCanaryP95Factor", metavar="X",
+                      help="roll back when canary p95 exceeds stable "
+                           "p95 by this factor (default 3.0)")
+
     # Offline incident digest — reads RSL_PATH/incident-*.json written
     # by a fleet run; no flags beyond the run dir.
     p_inc = sub.add_parser(
@@ -896,6 +1082,37 @@ def config_from_argv(argv=None) -> Config:
                       fleet_stale_after=args.fleetStaleAfter,
                       fleet_max_cycles=args.fleetMaxCycles,
                       slo_spec=args.sloSpec)
+    if args.action == "frontdoor":
+        return Config(action="frontdoor", rsl_path=args.rsl_path,
+                      fd_port=args.fdPort,
+                      fd_ranks=args.fdRanks,
+                      serve_port=args.servePort,
+                      metrics_port=args.metricsPort,
+                      fd_interval=args.fdInterval,
+                      fd_upstream_timeout=args.fdUpstreamTimeout,
+                      fd_pending_budget=args.fdPendingBudget,
+                      fd_retry_after=args.fdRetryAfter,
+                      fd_eject_after=args.fdEjectAfter,
+                      fd_max_step_age=args.fdMaxStepAge,
+                      fd_max_cycles=args.fdMaxCycles,
+                      slo_spec=args.sloSpec,
+                      fleet_stale_after=args.fleetStaleAfter,
+                      fd_autoscale=args.fdAutoscale,
+                      fd_min_world=args.fdMinWorld,
+                      fd_max_world=args.fdMaxWorld,
+                      fd_queue_high=args.fdQueueHigh,
+                      fd_queue_low=args.fdQueueLow,
+                      fd_up_hold=args.fdUpHold,
+                      fd_down_hold=args.fdDownHold,
+                      fd_cooldown=args.fdCooldown,
+                      fd_launch_cmd=args.fdLaunchCmd,
+                      fd_rollout=args.fdRollout,
+                      fd_watch_dir=args.fdWatchDir,
+                      fd_canary_fraction=args.fdCanaryFraction,
+                      fd_canary_hold=args.fdCanaryHold,
+                      fd_canary_min_requests=args.fdCanaryMinRequests,
+                      fd_canary_max_error=args.fdCanaryMaxError,
+                      fd_canary_p95_factor=args.fdCanaryP95Factor)
     if args.action == "incidents":
         return Config(action="incidents", rsl_path=args.rsl_path)
     if args.action == "lint":

@@ -111,6 +111,21 @@ _parked: List[tuple] = []
 # keep-set for _close_stale_collective_sockets: coordination channels
 # (ours AND parked ones, which still heartbeat/poll) must never be cut.
 _coordinator_ports: set = set()
+_app_ports: set = set()
+
+
+def register_app_ports(*ports: int) -> None:
+    """Exempt application listener ports from the parked-generation
+    socket sweep (``_close_stale_collective_sockets``).
+
+    A serving replica keeps its HTTP listeners (predict port, metrics
+    exporter) open straight through a reconfigure — that is the
+    zero-downtime contract — but an accepted connection on those
+    listeners is an ESTABLISHED ephemeral<->app-port socket, exactly
+    the shape the sweep would otherwise cut: every in-flight proxied
+    request would die mid-response at each park.  Registered once per
+    process as soon as the ports are known (serve startup / join)."""
+    _app_ports.update(int(p) for p in ports if p)
 
 _generation = 0          # 0 = the original world (no reconfigure yet)
 _reconfigured = False
@@ -298,8 +313,22 @@ def manual_init(coordinator_address: str, num_processes: int,
     downstream (``xla_bridge.make_cpu_client``'s collectives wiring,
     ``jax.process_index()``) sees a normal distributed runtime.
     """
+    import jax
+
     from jax._src import distributed as jdist
     from jax._src.lib import xla_extension as xe
+
+    # Every generation's CPU client must be built with gloo cross-process
+    # collectives — including the first multi-process generation of a
+    # world that BOOTED solo (``--elastic`` with no coordinator sets no
+    # distributed state at startup, so runtime.initialize_distributed
+    # never ran its gloo branch) — or the next health allgather dies
+    # with "Multiprocess computations aren't implemented on the CPU
+    # backend".  Harmless on TPU (the option is CPU-specific).
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # older/newer jax without the option
+        pass
 
     gs = jdist.global_state
     if process_id == 0:
@@ -338,14 +367,16 @@ def _close_stale_collective_sockets() -> None:
     until this whole process exits).  So the sockets are closed by fd.
 
     Selection: ESTABLISHED TCP sockets whose ports are NOT a known
-    coordinator port on either end.  Gloo pairs are ephemeral-to-
-    ephemeral, while every coordination-service channel (gRPC) has a
-    coordinator port on one end — cutting one of those would fire the
-    parked client's fatal PollForError handler.  Gloo listeners are in
-    LISTEN state, so they survive too (harmless either way).  The
-    parked runtime never uses these fds again (that is what parking
-    means), so the close is one-way traffic: peers see EOF, we lose
-    nothing.
+    coordinator port or registered application port
+    (``register_app_ports`` — a serve replica's predict/metrics
+    listeners carry live client traffic through the reconfigure) on
+    either end.  Gloo pairs are ephemeral-to-ephemeral, while every
+    coordination-service channel (gRPC) has a coordinator port on one
+    end — cutting one of those would fire the parked client's fatal
+    PollForError handler.  Gloo listeners are in LISTEN state, so they
+    survive too (harmless either way).  The parked runtime never uses
+    these fds again (that is what parking means), so the close is
+    one-way traffic: peers see EOF, we lose nothing.
     """
     states = {}
     for table in ("/proc/net/tcp", "/proc/net/tcp6"):
@@ -378,6 +409,8 @@ def _close_stale_collective_sockets() -> None:
             continue
         if lport in _coordinator_ports or rport in _coordinator_ports:
             continue
+        if lport in _app_ports or rport in _app_ports:
+            continue  # live HTTP traffic, not a parked gloo pair
         try:
             os.close(int(fd))
             closed += 1

@@ -49,10 +49,9 @@ import os
 import re
 import threading
 import time
-import urllib.error
-import urllib.request
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
+from . import deadline as dl
 from . import slo, telemetry, tracing
 
 #: how many cycles of samples the SLO window can look back over, as a
@@ -298,21 +297,25 @@ class FleetCollector:
 
     # -- scraping ------------------------------------------------------
 
-    def _fetch(self, port: int, path: str) -> Optional[str]:
-        url = f"http://{self.host}:{port}{path}"
-        try:
-            with urllib.request.urlopen(
-                    url, timeout=_SCRAPE_TIMEOUT_S) as resp:
-                return resp.read().decode("utf-8", "replace")
-        except (urllib.error.URLError, OSError, ValueError):
-            return None
+    def _fetch(self, port: int, path: str,
+               budget: Optional[dl.Deadline] = None) -> Optional[str]:
+        # deadline.fetch: hard per-call timeout, bounded further by the
+        # cycle budget — a wedged exporter costs at most its share of
+        # one cycle, never a stall past --interval (ISSUE 19 satellite).
+        return dl.fetch(f"http://{self.host}:{port}{path}",
+                        _SCRAPE_TIMEOUT_S, deadline=budget)
 
     def scrape_once(self) -> Dict[str, Any]:
         """One full cycle: probe every candidate, age out the silent,
-        merge the alive, persist the sample, evaluate SLOs."""
+        merge the alive, persist the sample, evaluate SLOs.  The whole
+        scrape pass shares one Deadline budget — max(interval, one
+        scrape timeout) — so N wedged exporters degrade to failed
+        scrapes (age-out pressure), not a cycle that overruns its
+        period."""
         self.cycle += 1
+        budget = dl.Deadline(max(self.interval_s, _SCRAPE_TIMEOUT_S))
         for t in self._targets:
-            body = self._fetch(t.port, "/metrics")
+            body = self._fetch(t.port, "/metrics", budget)
             if body is None:
                 t.fails += 1
                 if t.fails >= self.stale_after and t.alive:
@@ -329,7 +332,7 @@ class FleetCollector:
                 logging.info(f"fleet: rank {t.rank} (:{t.port}) joined")
             t.alive = True
             t.parsed = parse_metrics(body)
-            health = self._fetch(t.port, "/healthz")
+            health = self._fetch(t.port, "/healthz", budget)
             try:
                 t.health = json.loads(health) if health else None
             except ValueError:

@@ -38,6 +38,25 @@ survivors reconfigure at the next health tick and keep answering, and
 numpy arrays, so they SURVIVE a reconfigure: only the batch in flight
 when the world broke is at risk — and that batch lives on the rank
 that died.
+
+ISSUE 19 adds the fleet front door on top — a separate JAX-free
+control-plane process (``main.py frontdoor``) clients talk to instead
+of picking a replica themselves:
+
+  frontdoor.py  one client port: health-aware routing (probe, eject,
+                readmit), fleet-level admission with Retry-After
+                shedding, deadline-bounded proxying with one retry on
+                another replica, and the control loop that feeds the
+                two policy modules below
+  controller.py the autoscale policy: pure decisions over the fleet
+                collector's merged samples (queue depth, shed
+                counters, SLO verdicts) with hysteresis, cooldown and
+                min/max-world clamps
+  rollout.py    the canary rollout policy + manager: watch the
+                checkpoint lineage ledger, canary a newer verified
+                checkpoint on a fraction of replicas via
+                /admin/reload, promote or auto-roll-back on the
+                canary-vs-stable error-rate/p95 comparison
 """
 
 from .planner import parse_buckets, choose_bucket, plan_batch  # noqa: F401

@@ -31,6 +31,20 @@ admission (shed-path testing), ``serve.infer`` fires per micro-batch in
 the driver — ioerror fails that batch's requests and the loop carries
 on; rank_loss vanishes the replica mid-dispatch, the chaos-gate shape
 survivors must absorb.
+
+Control plane (ISSUE 19): the front door (frontdoor.py) drives two
+admin endpoints.  ``POST /admin/drain`` starts a graceful retirement —
+new requests are shed with 503 + Retry-After, the queue flushes, and
+``run()`` returns once empty (the caller exits; an elastic world
+shrinks around it at the next boundary).  ``POST /admin/reload
+{"checkpoint": PATH}`` is the zero-downtime hot-swap seam: the handler
+parks a swap request, the DRIVER thread applies it between batches
+through the injected ``swap_fn(path) -> (infer_fn, lineage_info)``
+(built in cli.run_serve over ``restore_for_serving``), so the predict
+program is replaced with no listener restart and no mid-batch tear.
+``stats()`` (the ``/livez`` body and the exporter's ``/healthz`` serve
+block) reports the served checkpoint's lineage (sha256 + epoch) and
+the draining flag — what the front door's canary verdict keys on.
 """
 
 from __future__ import annotations
@@ -69,7 +83,13 @@ class ServingTier:
         self.max_requests = int(max_requests)
         self.batcher = MicroBatcher(self.buckets, max_queue, max_latency_s)
         self.answered = 0        # driver thread only
+        self.checkpoint: Optional[dict] = None  # lineage of the served ckpt
         self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._swap_fn: Optional[Callable[[str], Tuple]] = None
+        self._swap_lock = threading.Lock()
+        self._pending_swap: Optional[dict] = None
+        self.swap_timeout_s = 180.0
         self._server = None
         self._http_thread = None
 
@@ -84,7 +104,28 @@ class ServingTier:
 
         class _Handler(http.server.BaseHTTPRequestHandler):
             def do_POST(self):  # noqa: N802 - http.server API
-                if self.path.rstrip("/") != "/predict":
+                path = self.path.rstrip("/")
+                if path == "/admin/drain":
+                    tier.drain()
+                    tier._respond(self, 200, {"draining": True,
+                                              "queue_depth":
+                                                  tier.batcher.depth()})
+                    return
+                if path == "/admin/reload":
+                    try:
+                        tier._handle_reload(self)
+                    # broad on purpose: a reload failure must become
+                    # the caller's 500, never take the listener down
+                    except Exception as e:
+                        logging.error(f"serve: reload handler "
+                                      f"failed: {e}")
+                        try:
+                            tier._respond(self, 500,
+                                          {"error": repr(e)})
+                        except Exception:
+                            pass  # caller already gone mid-answer
+                    return
+                if path != "/predict":
                     self.send_error(404)
                     return
                 try:
@@ -132,6 +173,29 @@ class ServingTier:
         """Swap the predict program (post-reconfigure rebuild)."""
         self._infer = infer_fn
 
+    def set_checkpoint(self, info: Optional[dict]) -> None:
+        """Record the served checkpoint's lineage (sha256/epoch/path) —
+        surfaced on /livez and the exporter /healthz serve block, the
+        identity the front door's canary verdict compares."""
+        self.checkpoint = info
+
+    def set_swap_fn(self, fn: Callable[[str], Tuple]) -> None:
+        """Install the hot-swap builder: ``fn(path) -> (infer_fn,
+        lineage_info)`` — rebuilds the predict closure for a new
+        checkpoint (restore + warmup).  Without one, /admin/reload
+        answers 501."""
+        self._swap_fn = fn
+
+    def drain(self) -> None:
+        """Graceful retirement: stop admitting, flush in-flight, let
+        run() return once the queue is empty.  Idempotent."""
+        if not self._draining.is_set():
+            logging.info("serve: draining — admissions closed, "
+                         "flushing the queue")
+            telemetry.get().event("serve/drain_start",
+                                  queue_depth=self.batcher.depth())
+        self._draining.set()
+
     def stop(self) -> None:
         """Ask the driver loop to exit at the next boundary."""
         self._stop.set()
@@ -162,9 +226,58 @@ class ServingTier:
         handler.end_headers()
         handler.wfile.write(body)
 
+    def _handle_reload(self, handler) -> None:
+        """The /admin/reload endpoint: park a swap request for the
+        driver thread and wait for it to apply between batches — the
+        zero-downtime checkpoint hot-swap (rollout.py drives this)."""
+        if self._swap_fn is None:
+            self._respond(handler, 501,
+                          {"error": "no swap_fn installed "
+                                    "(stub tier or pre-ISSUE-19 "
+                                    "driver)"})
+            return
+        try:
+            n = int(handler.headers.get("Content-Length", 0))
+            doc = json.loads(handler.rfile.read(n) or b"{}")
+            path = doc["checkpoint"]
+        except (KeyError, TypeError, ValueError) as e:
+            self._respond(handler, 400,
+                          {"error": f"bad reload request: {e}"})
+            return
+        swap = {"path": str(path), "done": threading.Event(),
+                "error": None, "info": None}
+        with self._swap_lock:
+            if self._pending_swap is not None:
+                self._respond(handler, 409,
+                              {"error": "a swap is already in flight"})
+                return
+            self._pending_swap = swap
+        if not swap["done"].wait(self.swap_timeout_s):
+            self._respond(handler, 504,
+                          {"error": f"swap did not apply within "
+                                    f"{self.swap_timeout_s:g}s"})
+            return
+        if swap["error"] is not None:
+            self._respond(handler, 500, {"error": swap["error"]})
+            return
+        self._respond(handler, 200, {"reloaded": True,
+                                     "checkpoint": swap["info"]})
+
     def _handle_predict(self, handler) -> None:
         tel = telemetry.get()
         tel.counter("serve/requests").add()
+        if self._draining.is_set():
+            # retirement: shed loudly so the front door routes around
+            # us while the queue flushes (same 503 contract as full)
+            tel.counter("serve/shed").add()
+            body = json.dumps({"error": "draining"}).encode("utf-8")
+            handler.send_response(503)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Retry-After", "1")
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+            return
         try:
             faults.fire("serve.request")
             n = int(handler.headers.get("Content-Length", 0))
@@ -248,6 +361,12 @@ class ServingTier:
                 break  # single-replica SIGTERM: no agreement needed
             if self.max_requests and self.answered >= self.max_requests:
                 break
+            if self._draining.is_set() and self.batcher.depth() == 0:
+                tel.event("serve/drain_done", answered=self.answered)
+                logging.info(f"serve: drained after answering "
+                             f"{self.answered} requests")
+                break
+            self._apply_swap(tel)
             batch = self.batcher.next_batch(_TICK_S)
             if batch is not None:
                 self._run_batch(tel, *batch)
@@ -259,6 +378,44 @@ class ServingTier:
                     break
                 next_health = time.monotonic() + health_tick_s
         return self.answered
+
+    def _apply_swap(self, tel) -> None:
+        """Driver-thread-only: apply a parked /admin/reload between
+        batches.  The swap builder runs on the one thread that owns
+        dispatch, so the predict program is never replaced mid-batch;
+        queued requests simply wait out the restore+warmup (persistent-
+        cache hits make that seconds) and are answered by the NEW
+        program."""
+        with self._swap_lock:
+            swap = self._pending_swap
+        if swap is None:
+            return
+        try:
+            infer_fn, info = self._swap_fn(swap["path"])
+            self._infer = infer_fn
+            self.checkpoint = info
+            tracing.get().set_lineage(
+                (info or {}).get("sha256"))
+            swap["info"] = info
+            tel.event("serve/swap",
+                      checkpoint=(info or {}).get("file"),
+                      sha=str((info or {}).get("sha256"))[:12],
+                      epoch=(info or {}).get("epoch"))
+            logging.info(f"serve: hot-swapped to "
+                         f"{(info or {}).get('file')} "
+                         f"(sha {str((info or {}).get('sha256'))[:12]})")
+        except Exception as e:
+            # a bad candidate (torn file, wrong model) must fail THIS
+            # reload and leave the serving program untouched
+            swap["error"] = repr(e)
+            tel.event("serve/swap_failed", path=swap["path"],
+                      error=repr(e))
+            logging.error(f"serve: hot-swap to {swap['path']!r} "
+                          f"failed: {e}")
+        finally:
+            with self._swap_lock:
+                self._pending_swap = None
+            swap["done"].set()
 
     def _run_batch(self, tel, reqs: List[Request], bucket: int) -> None:
         arr = np.zeros((bucket,) + self.sample_shape, self.sample_dtype)
@@ -308,11 +465,16 @@ class ServingTier:
     # -- introspection -------------------------------------------------
 
     def stats(self) -> dict:
-        """/livez body + the exporter's extra-health payload."""
+        """/livez body + the exporter's extra-health payload.  The
+        ``checkpoint`` block (lineage sha256 + epoch + path) is the
+        served-model identity the front door's rollout verdict keys
+        on; ``draining`` tells it to stop routing here."""
         return {
             "ok": True,
             "queue_depth": self.batcher.depth(),
             "answered": self.answered,
             "buckets": list(self.buckets),
             "port": self.port,
+            "draining": self._draining.is_set(),
+            "checkpoint": self.checkpoint,
         }

@@ -35,7 +35,10 @@ lock).  Record schema:
    "status": 200, "outcome": "answered",      # answered|shed|timeout|failed
    "bucket": 4,                               # answered/failed only
    "spans": {"queue_wait": s, "batch_form": s, "infer": s, "respond": s},
-   "total_s": <sum of spans>, "latency_ms": <histogram observation>}
+   "total_s": <sum of spans>, "latency_ms": <histogram observation>,
+   "lineage": "<sha256[:12]>"}   # serving lineage id, when set — the
+                                 # checkpoint version that answered
+                                 # (set_lineage; updated per hot-swap)
 
 Clock contract (telemetry.py): ``ts`` stamps are wall clock and never
 subtracted; ``mono`` orders records; every duration is a perf_counter
@@ -154,10 +157,18 @@ class Tracer:
         self.rank = int(rank)
         self.path = os.path.join(rsl_path, f"trace-rank{self.rank}.jsonl")
         self.write_errors = 0
+        self.lineage: Optional[str] = None
         self._seq = 0
         self._lock = threading.Lock()
         self._file = None
         self._sink_dead = False
+
+    def set_lineage(self, sha256: Optional[str]) -> None:
+        """The serving lineage id (the served checkpoint's sha256,
+        ISSUE 19 satellite): stamped into every subsequent record so an
+        incident can say WHICH model version answered each request —
+        updated at startup and at every /admin/reload hot-swap."""
+        self.lineage = str(sha256)[:12] if sha256 else None
 
     def start(self) -> Optional[RequestTrace]:
         """Allocate the next request id and its trace (None when
@@ -173,6 +184,8 @@ class Tracer:
         # stamp-only wall time plus the ordering clock.
         record["ts"] = time.time()
         record["mono"] = time.monotonic()
+        if self.lineage is not None:
+            record["lineage"] = self.lineage
         with self._lock:
             if trace._finished:
                 return  # the 504-then-late-complete race: first wins

@@ -78,7 +78,15 @@
 #      one bundle naming the failing rank + its request ids, a rank
 #      loss ages out of the fleet series — see scripts/chaos_gate.py
 #      --stage fleet and README "Fleet observability & SLOs"
-#  15. the driver's own gate: __graft_entry__.dryrun_multichip(8)
+#  15. rollout gate: ``main.py frontdoor`` over real serve replicas —
+#      a fault-injected canary checkpoint auto-rolls back with zero
+#      client-visible 500s and its sha blacklisted, a SIGKILLed
+#      replica is ejected and repaired via the controller's
+#      --elastic-join launch while clients keep seeing 200s, and a
+#      fleet already serving the ledger head draws zero rollbacks and
+#      zero scale events — see scripts/rollout_gate.py and README
+#      "Front door, autoscaling & rollout"
+#  16. the driver's own gate: __graft_entry__.dryrun_multichip(8)
 #      (clean env, exactly as the driver runs it)
 #
 # Tier map:
@@ -100,7 +108,8 @@ python - /tmp/graftlint_gate.json <<'PY'
 import json, sys
 payload = json.load(open(sys.argv[1]))
 missing = {"collective-divergence", "lock-order-cycle",
-           "mesh-axis-propagation"} - set(payload["rules"])
+           "mesh-axis-propagation",
+           "outbound-call-without-timeout"} - set(payload["rules"])
 assert not missing, f"whole-program rules inactive: {sorted(missing)}"
 assert payload["findings"] == [], payload["findings"]
 print(f"whole-program rules active ({len(payload['rules'])} total), "
@@ -167,6 +176,9 @@ env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py --stage serve
 
 echo "== gate: fleet (SLO burn rate / incidents / age-out) =="
 env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py --stage fleet
+
+echo "== gate: rollout (canary rollback / kill+join repair / clean) =="
+env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/rollout_gate.py
 
 echo "== gate: dryrun_multichip(8) =="
 env -u XLA_FLAGS -u JAX_PLATFORMS python -c \

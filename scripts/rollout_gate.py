@@ -1,0 +1,643 @@
+#!/usr/bin/env python3
+"""Front-door / autoscale / rollout gate leg (scripts/gate.sh), on CPU.
+
+Proves ``main.py frontdoor`` end to end over real ``main.py serve``
+replicas — the ISSUE-19 robustness contracts, each against live HTTP:
+
+  0. provenance — a 2-epoch synthetic mlp run leaves a rolling
+     checkpoint lineage: the oldest verified ledger entry is the
+     fleet's STABLE, the newest the head the watcher will canary.
+  A. canary auto-rollback, zero client-visible 500s — two replicas on
+     the stable checkpoint; replica 0 fault-injected so every infer
+     500s.  The front door (``--rollout``) canaries the ledger head
+     onto replica 0, the judge sees the canary error ratio dwarf
+     stable's, rolls back, restores the stable checkpoint onto the
+     replica and blacklists the sha — while closed-loop clients see
+     nothing but 200s (retry-once absorbs every canary 500).
+  B. kill + --elastic-join repair while answering — a real 2-process
+     elastic serve world (rank 1 joined via ``main.py serve --elastic
+     --elastic-join``).  SIGKILL rank 1 mid-load: the front door
+     ejects it, the embedded collector ages it out, the autoscale
+     controller repairs world < min_world by launching the SAME
+     join command, and the joiner re-enters at rank 1 (its old
+     port) — clients keep seeing 200s through the whole window.
+  C. clean control — two replicas already serving the ledger head:
+     zero rollbacks, zero promotions, zero scale events, all 200s,
+     and every trace record stamped with the served lineage sha.
+
+Run as ``env -u XLA_FLAGS JAX_PLATFORMS=cpu python
+scripts/rollout_gate.py``.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from distributedpytorch_tpu.serving.rollout import (  # noqa: E402
+    LINEAGE_FILE, newest_lineage_entry)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MAIN = os.path.join(REPO, "main.py")
+
+SAMPLE = [[(r * 28 + c) % 256 for c in range(28)] for r in range(28)]
+CANARY_FAULT = "serve.infer:ioerror:0:1000000"
+LIVE_WAIT_S = 150.0
+JOIN_WAIT_S = 240.0
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _free_block(n: int) -> int:
+    """A base port with ``n`` consecutive free ports above it (the
+    front door maps replica slot i to base + i)."""
+    for _ in range(64):
+        base = _free_port()
+        socks = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError(f"no block of {n} consecutive free ports")
+
+
+def _env() -> dict:
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _scrape(port: int, path: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return r.read().decode("utf-8")
+
+
+def _post(port: int, timeout: float = 150.0):
+    """One /predict round trip through the front door -> (status,
+    body dict).  Transport failures return (-1, {"error": repr})."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps({"image": SAMPLE}).encode())
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read())
+        except ValueError:
+            body = {}
+        return e.code, body
+    except OSError as e:
+        return -1, {"error": repr(e)}
+
+
+def _status(port: int) -> dict:
+    """The front door's own /healthz (status_doc)."""
+    return json.loads(_scrape(port, "/healthz"))
+
+
+def _wait_live(port: int, proc, timeout_s: float, what: str,
+               log: str = "") -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if proc is not None and proc.poll() is not None:
+            return False
+        try:
+            if json.loads(_scrape(port, "/livez")).get("ok"):
+                return True
+        except (OSError, ValueError):
+            time.sleep(0.3)
+    return False
+
+
+def _wait_status(port: int, pred, timeout_s: float):
+    """Poll the front door's status doc until ``pred(doc)`` or
+    timeout; returns the last doc (or None if never reachable)."""
+    doc = None
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            doc = _status(port)
+            if pred(doc):
+                return doc
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.3)
+    return doc
+
+
+def _events(rsl: str, rank: int):
+    path = os.path.join(rsl, "telemetry", f"rank{rank}.jsonl")
+    out = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    pass
+    except OSError:
+        pass
+    return out
+
+
+def _tail(path: str, n: int = 30) -> str:
+    try:
+        with open(path, errors="replace") as f:
+            return "".join(f.readlines()[-n:])
+    except OSError:
+        return f"<no log at {path}>"
+
+
+def _serve_cmd(rsl: str, ckpt: str, port: int, cache: str,
+               metrics_port: int = 0, extra=()):
+    cmd = [sys.executable, MAIN, "serve", "-d", "/nodata",
+           "--dataset", "synthetic", "--model", "mlp", "-f", ckpt,
+           "--rsl_path", rsl, "--serve-port", str(port),
+           "--serve-buckets", "1,8", "--serve-max-latency-ms", "5",
+           "--serve-queue", "64",
+           "--compilation-cache-dir", cache]
+    if metrics_port:
+        cmd += ["--metrics-port", str(metrics_port)]
+    return cmd + list(extra)
+
+
+def _launch(cmd, log_path: str):
+    log = open(log_path, "wb")
+    proc = subprocess.Popen(cmd, cwd=REPO, env=_env(), stdout=log,
+                            stderr=subprocess.STDOUT)
+    return proc, log
+
+
+def _stop(proc, log, problems, tag: str, timeout_s: float = 90.0):
+    """SIGTERM -> clean rc 0 (drain / coordinated preempt)."""
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+    try:
+        rc = proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        problems.append(f"{tag}: hung on SIGTERM — drain broke "
+                        f"(killed)\n{_tail(log.name)}")
+        rc = None
+    if rc not in (0, None):
+        problems.append(f"{tag}: SIGTERM exit rc={rc}, expected 0"
+                        f"\n{_tail(log.name)}")
+    log.close()
+
+
+class _Load:
+    """Closed-loop client threads against the front door; every
+    (status, body) is recorded for the zero-5xx assertions."""
+
+    def __init__(self, port: int, clients: int = 2,
+                 pause_s: float = 0.02):
+        self.port = port
+        self.pause_s = pause_s
+        self.results = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = [threading.Thread(target=self._run, daemon=True)
+                         for _ in range(clients)]
+
+    def _run(self):
+        while not self._stop.is_set():
+            out = _post(self.port)
+            with self._lock:
+                self.results.append(out)
+            self._stop.wait(self.pause_s)
+
+    def start(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=180)
+        return self.results
+
+    def bad(self):
+        with self._lock:
+            return [(s, b) for s, b in self.results if s != 200]
+
+
+# -- stage A: canary rollback, zero client 500s ------------------------
+
+def stage_canary_rollback(work, rsl, cache, stable, stable_sha, head):
+    problems = []
+    base = _free_block(2)
+    fdp = _free_port()
+    fd_rsl = os.path.join(work, "fd_a")
+    reps = []
+    for i, extra in ((0, ("--fault-plan", CANARY_FAULT)), (1, ())):
+        rrsl = os.path.join(work, f"rsl_a{i}")
+        reps.append(_launch(
+            _serve_cmd(rrsl, stable, base + i, cache, extra=extra),
+            os.path.join(work, f"serve_a{i}.log")))
+    fd = None
+    load = None
+    try:
+        for i, (proc, log) in enumerate(reps):
+            if not _wait_live(base + i, proc, LIVE_WAIT_S,
+                              f"replica {i}"):
+                return [f"A: replica {i} never went live on "
+                        f":{base + i}\n{_tail(log.name)}"]
+        fd = _launch(
+            [sys.executable, MAIN, "frontdoor", "--rsl_path", fd_rsl,
+             "--port", str(fdp), "--ranks", "2",
+             "--serve-port", str(base), "--interval", "0.3",
+             "--upstream-timeout", "30", "--rollout",
+             "--watch-dir", rsl, "--canary-fraction", "0.34",
+             "--canary-hold", "60", "--canary-min-requests", "6",
+             "--canary-max-error", "0.2"],
+            os.path.join(work, "frontdoor_a.log"))
+        doc = _wait_status(
+            fdp, lambda d: all(d["upstreams"][str(i)]["alive"]
+                               for i in (0, 1)), 60.0)
+        if not doc or not all(doc["upstreams"][str(i)]["alive"]
+                              for i in (0, 1)):
+            return [f"A: front door never probed both replicas alive: "
+                    f"{doc}\n{_tail(fd[1].name)}"]
+        load = _Load(fdp).start()
+        doc = _wait_status(
+            fdp, lambda d: d["rollout"]["rollbacks"] >= 1, 120.0)
+        if not doc or doc["rollout"]["rollbacks"] < 1:
+            problems.append(f"A: no rollback within 120s — rollout "
+                            f"doc {doc and doc['rollout']}"
+                            f"\n{_tail(fd[1].name)}")
+        else:
+            print(f"rollout gate A: canary rolled back "
+                  f"(doc: {doc['rollout']})")
+            if doc["rollout"]["phase"] != "stable" \
+                    or doc["rollout"]["canary_ids"]:
+                problems.append(f"A: post-rollback rollout state not "
+                                f"stable: {doc['rollout']}")
+            # the rejected sha must never canary again
+            time.sleep(2.0)
+            doc2 = _status(fdp)
+            if doc2["rollout"]["rollbacks"] != 1 \
+                    or doc2["rollout"]["phase"] != "stable":
+                problems.append(f"A: rejected sha canaried again: "
+                                f"{doc2['rollout']}")
+            # replica 0 restored onto the stable checkpoint
+            doc3 = _wait_status(
+                fdp, lambda d: (d["upstreams"]["0"]["lineage"] or {})
+                .get("sha256") == stable_sha, 30.0)
+            got = ((doc3 or {}).get("upstreams", {}).get("0", {})
+                   .get("lineage") or {}).get("sha256")
+            if got != stable_sha:
+                problems.append(f"A: replica 0 lineage after rollback "
+                                f"is {got!r}, expected the stable sha "
+                                f"{stable_sha[:12]}")
+        results = load.stop()
+        load = None
+        fives = [(s, b) for s, b in results if s >= 500 or s < 0]
+        if fives:
+            problems.append(f"A: {len(fives)} client-visible failures "
+                            f"through the canary+rollback window, "
+                            f"first: {fives[0]} — retry-once did not "
+                            f"absorb the canary 500s")
+        if not any(s == 200 for s, _ in results):
+            problems.append("A: no client 200s at all — nothing was "
+                            "actually served")
+        doc = _status(fdp)
+        if doc["retries"] < 1:
+            problems.append(f"A: retries={doc['retries']} — the faulted "
+                            f"canary never exercised retry-once")
+        names = [e.get("name") for e in _events(fd_rsl, 90)]
+        for needed in ("frontdoor_start", "rollout/canary_start",
+                       "rollout/rollback"):
+            if needed not in names:
+                problems.append(f"A: telemetry event {needed!r} missing "
+                                f"from the front door's JSONL ({names})")
+        print(f"rollout gate A: {len(results)} client requests, "
+              f"{len(fives)} failures, retries={doc['retries']}")
+    finally:
+        if load is not None:
+            load.stop()
+        if fd is not None:
+            _stop(fd[0], fd[1], problems, "A: frontdoor", 30.0)
+        for i, (proc, log) in enumerate(reps):
+            _stop(proc, log, problems, f"A: replica {i}")
+    return problems
+
+
+# -- stage B: SIGKILL + --elastic-join repair --------------------------
+
+def stage_kill_and_join(work, rsl, cache, head):
+    problems = []
+    base = _free_block(3)
+    mb = _free_block(3)
+    fdp = _free_port()
+    rsl_b = os.path.join(work, "rsl_b")     # shared by world members
+    fd_rsl = os.path.join(work, "fd_b")
+    elastic = ("--elastic", "--health-timeout", "5",
+               "--max-reconfigures", "6",
+               "--serve-request-timeout", "120")
+    rank0 = _launch(
+        _serve_cmd(rsl_b, head["path"], base, cache,
+                   metrics_port=mb, extra=elastic),
+        os.path.join(work, "serve_b0.log"))
+    join_cmd = _serve_cmd(rsl_b, head["path"], base, cache,
+                          metrics_port=mb,
+                          extra=elastic + ("--elastic-join",))
+    fd = None
+    joiner = None
+    load = None
+    try:
+        if not _wait_live(base, rank0[0], LIVE_WAIT_S, "rank 0"):
+            return [f"B: rank 0 never went live on :{base}"
+                    f"\n{_tail(rank0[1].name)}"]
+        # grow the world to 2 through the SAME join command the
+        # controller will later use for the repair
+        joiner = _launch(join_cmd, os.path.join(work, "serve_b1.log"))
+        if not _wait_live(base + 1, joiner[0], JOIN_WAIT_S, "joiner"):
+            return [f"B: elastic joiner never went live on "
+                    f":{base + 1}\n{_tail(joiner[1].name)}"]
+        print("rollout gate B: 2-process elastic serve world up "
+              "(rank 1 via --elastic-join)")
+        fd = _launch(
+            [sys.executable, MAIN, "frontdoor", "--rsl_path", fd_rsl,
+             "--port", str(fdp), "--ranks", "2",
+             "--serve-port", str(base), "--metrics-port", str(mb),
+             "--interval", "0.5", "--upstream-timeout", "60",
+             "--stale-after", "3", "--autoscale",
+             "--min-world", "2", "--max-world", "2",
+             "--queue-high", "999999", "--queue-low", "0",
+             "--up-hold", "2", "--down-hold", "3600",
+             "--cooldown", "120",
+             "--launch-cmd", " ".join(join_cmd)],
+            os.path.join(work, "frontdoor_b.log"))
+        doc = _wait_status(
+            fdp, lambda d: all(d["upstreams"][str(i)]["alive"]
+                               for i in (0, 1)), 60.0)
+        if not doc or not all(doc["upstreams"][str(i)]["alive"]
+                              for i in (0, 1)):
+            return [f"B: front door never probed both replicas alive: "
+                    f"{doc}\n{_tail(fd[1].name)}"]
+        load = _Load(fdp).start()
+        doc = _wait_status(
+            fdp, lambda d: all(d["upstreams"][str(i)]["requests"] > 0
+                               for i in (0, 1)), 30.0)
+        if not doc or not all(doc["upstreams"][str(i)]["requests"] > 0
+                              for i in (0, 1)):
+            problems.append(f"B: load never reached both replicas "
+                            f"before the kill: {doc}")
+        served_before = doc["upstreams"]["1"]["requests"] if doc else 0
+        joiner[0].kill()    # SIGKILL: no drain, no goodbye
+        print("rollout gate B: rank 1 SIGKILLed mid-load")
+        doc = _wait_status(fdp, lambda d: d["scale_events"] >= 1, 120.0)
+        if not doc or doc["scale_events"] < 1:
+            problems.append(
+                f"B: controller never repaired the world within 120s "
+                f"(scale_events={doc and doc['scale_events']})"
+                f"\n{_tail(fd[1].name)}\n--- join-1.log ---\n"
+                f"{_tail(os.path.join(fd_rsl, 'join-1.log'))}")
+        else:
+            doc = _wait_status(
+                fdp, lambda d: (d["upstreams"]["1"]["alive"]
+                                and not d["upstreams"]["1"]["ejected"]
+                                and d["upstreams"]["1"]["requests"]
+                                > served_before), JOIN_WAIT_S)
+            up1 = (doc or {}).get("upstreams", {}).get("1", {})
+            if not up1.get("alive") or up1.get("ejected") \
+                    or up1.get("requests", 0) <= served_before:
+                problems.append(
+                    f"B: replacement joiner never took traffic on slot "
+                    f"1 (snapshot {up1})\n{_tail(fd[1].name)}\n"
+                    f"--- join-1.log ---\n"
+                    f"{_tail(os.path.join(fd_rsl, 'join-1.log'))}")
+            else:
+                print(f"rollout gate B: slot 1 repaired and serving "
+                      f"again ({up1['requests']} requests, "
+                      f"{served_before} before the kill)")
+        results = load.stop()
+        load = None
+        fives = [(s, b) for s, b in results if s >= 500 or s < 0]
+        if fives:
+            problems.append(f"B: {len(fives)} client-visible failures "
+                            f"through the kill+repair window, first: "
+                            f"{fives[0]}")
+        doc = _status(fdp)
+        if doc["scale_events"] > 1:
+            problems.append(f"B: {doc['scale_events']} scale events for "
+                            f"one dead replica — the cooldown did not "
+                            f"hold")
+        events = _events(fd_rsl, 90)
+        ups = [e for e in events
+               if e.get("name") == "controller/scale_up"]
+        if not ups:
+            problems.append("B: no controller/scale_up telemetry event")
+        elif "min_world" not in str(
+                ups[0].get("attrs", {}).get("reason", "")):
+            problems.append(f"B: scale_up reason is not the min_world "
+                            f"repair: {ups[0]}")
+        names = [e.get("name") for e in events]
+        for needed in ("frontdoor/eject", "frontdoor/readmit"):
+            if needed not in names:
+                problems.append(f"B: telemetry event {needed!r} missing "
+                                f"— the kill/recovery was not recorded")
+        print(f"rollout gate B: {len(results)} client requests, "
+              f"{len(fives)} failures, scale_events="
+              f"{doc['scale_events']}")
+    finally:
+        if load is not None:
+            load.stop()
+        if fd is not None:
+            _stop(fd[0], fd[1], problems, "B: frontdoor", 30.0)
+        # SIGTERM rank 0: the shutdown vote rides the health agreement,
+        # so the controller-launched joiner (not our child) stops too
+        _stop(rank0[0], rank0[1], problems, "B: rank 0", 120.0)
+        if joiner is not None and joiner[0].poll() is None:
+            joiner[0].kill()
+            joiner[0].wait()
+        if joiner is not None:
+            joiner[1].close()
+        subprocess.run(["pkill", "-f", rsl_b],
+                       capture_output=True)  # stray joiner, if any
+    return problems
+
+
+# -- stage C: clean control — nothing to do, nothing done --------------
+
+def stage_clean_control(work, rsl, cache, head):
+    problems = []
+    base = _free_block(2)
+    mb = _free_block(2)
+    fdp = _free_port()
+    fd_rsl = os.path.join(work, "fd_c")
+    reps = []
+    for i in range(2):
+        rrsl = os.path.join(work, f"rsl_c{i}")
+        reps.append(_launch(
+            _serve_cmd(rrsl, head["path"], base + i, cache,
+                       metrics_port=mb + i),
+            os.path.join(work, f"serve_c{i}.log")))
+    fd = None
+    load = None
+    try:
+        for i, (proc, log) in enumerate(reps):
+            if not _wait_live(base + i, proc, LIVE_WAIT_S,
+                              f"replica {i}"):
+                return [f"C: replica {i} never went live on "
+                        f":{base + i}\n{_tail(log.name)}"]
+        fd = _launch(
+            [sys.executable, MAIN, "frontdoor", "--rsl_path", fd_rsl,
+             "--port", str(fdp), "--ranks", "2",
+             "--serve-port", str(base), "--metrics-port", str(mb),
+             "--interval", "0.3", "--rollout", "--watch-dir", rsl,
+             "--autoscale", "--min-world", "2", "--max-world", "2",
+             "--queue-low", "0", "--down-hold", "30"],
+            os.path.join(work, "frontdoor_c.log"))
+        doc = _wait_status(
+            fdp, lambda d: all(d["upstreams"][str(i)]["alive"]
+                               for i in (0, 1)), 60.0)
+        if not doc:
+            return [f"C: front door never came up\n{_tail(fd[1].name)}"]
+        load = _Load(fdp).start()
+        time.sleep(6.0)
+        results = load.stop()
+        load = None
+        bad = [(s, b) for s, b in results if s != 200]
+        if bad:
+            problems.append(f"C: {len(bad)} non-200 answers on a "
+                            f"healthy fleet, first: {bad[0]}")
+        doc = _status(fdp)
+        ro = doc["rollout"]
+        if ro["rollbacks"] or ro["promotions"] \
+                or ro["phase"] != "stable":
+            problems.append(f"C: the watcher acted on a fleet already "
+                            f"serving the ledger head: {ro}")
+        if doc["scale_events"]:
+            problems.append(f"C: {doc['scale_events']} scale events on "
+                            f"a healthy, idle-enough fleet")
+        for i in (0, 1):
+            got = (doc["upstreams"][str(i)]["lineage"] or {}) \
+                .get("sha256")
+            if got != head["sha256"]:
+                problems.append(f"C: replica {i} reports lineage "
+                                f"{got!r}, expected the head "
+                                f"{head['sha256'][:12]}")
+        # satellite: every trace record carries the served lineage id
+        tpath = os.path.join(work, "rsl_c0", "trace-rank0.jsonl")
+        recs = []
+        try:
+            with open(tpath, encoding="utf-8") as f:
+                recs = [json.loads(x) for x in f if x.strip()]
+        except (OSError, ValueError) as e:
+            problems.append(f"C: cannot read replica traces: {e}")
+        want = head["sha256"][:12]
+        unstamped = [r for r in recs if r.get("lineage") != want]
+        if not recs:
+            problems.append("C: no trace records at all")
+        elif unstamped:
+            problems.append(f"C: {len(unstamped)}/{len(recs)} trace "
+                            f"records missing the serving lineage "
+                            f"{want!r}, first: {unstamped[0]}")
+        print(f"rollout gate C: {len(results)} requests all clean, "
+              f"{len(recs)} trace records stamped {want}")
+    finally:
+        if load is not None:
+            load.stop()
+        if fd is not None:
+            _stop(fd[0], fd[1], problems, "C: frontdoor", 30.0)
+        for i, (proc, log) in enumerate(reps):
+            _stop(proc, log, problems, f"C: replica {i}")
+    return problems
+
+
+def main() -> int:
+    work = tempfile.mkdtemp(prefix="rollout_gate_")
+    rsl = os.path.join(work, "rsl")
+    cache = os.path.join(rsl, "xla_cache")
+
+    t0 = time.perf_counter()
+    train = subprocess.run(
+        [sys.executable, MAIN, "train", "-d", "/nodata",
+         "--dataset", "synthetic", "--model", "mlp", "-b", "8",
+         "-e", "2", "--keep-ckpts", "2", "--rsl_path", rsl],
+        cwd=REPO, env=_env(), capture_output=True, text=True)
+    if train.returncode != 0:
+        print(f"PROBLEM: provenance training run failed "
+              f"rc={train.returncode}:\n{train.stdout[-800:]}\n"
+              f"{train.stderr[-800:]}", file=sys.stderr)
+        return 1
+    head = newest_lineage_entry(rsl)
+    problems = []
+    if head is None:
+        problems.append(f"no ledger head in {rsl}/{LINEAGE_FILE}")
+    stable = stable_sha = None
+    if not problems:
+        # the STABLE is the oldest verified ledger entry that is not
+        # the head — the --keep-ckpts 2 rotation must have kept it
+        try:
+            with open(os.path.join(rsl, LINEAGE_FILE)) as f:
+                led = json.load(f)
+            older = [
+                r for r in led["records"]
+                if r.get("sha256") and r["sha256"] != head["sha256"]
+                and os.path.isfile(os.path.join(rsl,
+                                                str(r.get("file", ""))))]
+            rec = min(older, key=lambda r: int(r.get("epoch", 1 << 30)))
+            stable = os.path.join(rsl, rec["file"])
+            stable_sha = rec["sha256"]
+        except (OSError, ValueError, KeyError) as e:
+            problems.append(f"no older lineage-verified checkpoint to "
+                            f"act as the stable (head "
+                            f"{head['file']}): {e!r}")
+    if problems:
+        for p in problems:
+            print(f"PROBLEM: {p}", file=sys.stderr)
+        return 1
+    print(f"rollout gate 0: lineage trained in "
+          f"{time.perf_counter() - t0:.1f}s — stable "
+          f"{stable_sha[:12]}, head {head['file']} "
+          f"({head['sha256'][:12]})")
+
+    problems += stage_canary_rollback(work, rsl, cache, stable,
+                                      stable_sha, head)
+    problems += stage_kill_and_join(work, rsl, cache, head)
+    problems += stage_clean_control(work, rsl, cache, head)
+
+    for p in problems:
+        print(f"PROBLEM: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print("rollout gate OK: bad canary rolled back with zero client "
+          "500s and the sha blacklisted; a SIGKILLed replica was "
+          "ejected, repaired via --elastic-join and readmitted while "
+          "clients saw only 200s; a fleet already on the ledger head "
+          "drew zero rollbacks and zero scale events, every trace "
+          "stamped with the served lineage")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

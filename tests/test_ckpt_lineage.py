@@ -180,3 +180,26 @@ def test_garbage_meta_is_one_line_actionable(tmp_path):
     assert "garbage meta.json" in msg
     assert "restore from" in msg  # says what to DO about it
     assert "\n" not in msg
+
+
+# -- lineage_info: the served-model identity (ISSUE 19) -----------------
+
+
+def test_lineage_info_reads_ledger_and_hashes_loose_files(tmp_path,
+                                                          trained_state):
+    import hashlib
+
+    _, state = trained_state
+    (path,) = _save_epochs(str(tmp_path), state, [2])
+    info = ckpt.lineage_info(path)
+    assert info["epoch"] == 2 and len(info["sha256"]) == 64
+    assert info["file"] == os.path.basename(path)
+    assert ckpt.verify_checkpoint(path) is None
+    # pre-lineage loose file: identity computed from content
+    loose = tmp_path / "loose.ckpt"
+    loose.write_bytes(b"payload")
+    info2 = ckpt.lineage_info(str(loose))
+    assert info2["sha256"] == hashlib.sha256(b"payload").hexdigest()
+    assert info2["epoch"] is None
+    # unreadable path: no identity, no exception
+    assert ckpt.lineage_info(str(tmp_path / "missing.ckpt")) is None

@@ -1,0 +1,155 @@
+"""Autoscale policy (serving/controller.py, ISSUE 19 tentpole 2).
+
+Every test drives ``decide_scale`` with synthetic fleet sample windows
+— the pure/clock-free contract means no sockets, no sleeps, no clock:
+the samples carry their own ``t``.  The two behavioral pins the issue
+names live here: a load RAMP grows the tier on queue depth before the
+shed counter moves, and a DIURNAL series oscillating between the two
+thresholds never flaps the world size.
+"""
+
+from distributedpytorch_tpu.serving.controller import (FD_SHED_COUNTER,
+                                                       QUEUE_GAUGE,
+                                                       SHED_COUNTER,
+                                                       decide_scale,
+                                                       pick_retire)
+
+
+def _s(t, world=2, depth=0.0, shed=0.0, fd_shed=0.0, firing=False):
+    """One fleet sample in the collector's merged-series shape."""
+    return {
+        "t": float(t),
+        "alive": list(range(world)),
+        "gauges": {QUEUE_GAUGE: float(depth)},
+        "counters": {SHED_COUNTER: float(shed),
+                     FD_SHED_COUNTER: float(fd_shed)},
+        "verdicts": ([{"name": "availability", "firing": True}]
+                     if firing else []),
+    }
+
+
+CFG = {"min_world": 1, "max_world": 4, "queue_high": 8.0,
+       "queue_low": 1.0, "up_hold_s": 2.0, "down_hold_s": 10.0,
+       "cooldown_s": 5.0}
+
+
+def _series(points, **kw):
+    return [_s(t, depth=d, **kw) for t, d in points]
+
+
+# -- scale up ----------------------------------------------------------
+
+def test_ramp_scales_up_on_queue_depth_before_any_shed():
+    """The issue's ramp scenario: queues fill, nothing sheds yet — the
+    tier must grow on the queue trigger, not wait for a shed floor."""
+    ramp = _series([(0, 2), (1, 9), (2, 10), (3, 12)])
+    d = decide_scale(CFG, {}, ramp)
+    # at t=3 the trailing 2s window is [1..3] — not all >= 8 yet? it is:
+    # depths 9,10,12.  The t=0 sample provides window coverage.
+    assert d["action"] == "up"
+    assert "queue depth" in d["reason"]
+    assert "shed" not in d["reason"]
+    assert d["target"] == 3
+
+
+def test_shed_movement_inside_window_is_the_backstop_trigger():
+    samples = [_s(0, depth=2.0, shed=5.0), _s(1, depth=2.0, shed=5.0),
+               _s(3, depth=2.0, shed=7.0)]
+    d = decide_scale(CFG, {}, samples)
+    assert d["action"] == "up" and "shed" in d["reason"]
+
+
+def test_frontdoor_admission_sheds_count_as_pressure():
+    samples = [_s(0), _s(1), _s(3, fd_shed=4.0)]
+    assert decide_scale(CFG, {}, samples)["action"] == "up"
+
+
+def test_firing_slo_verdict_scales_up():
+    samples = [_s(0), _s(1), _s(3, firing=True)]
+    d = decide_scale(CFG, {}, samples)
+    assert d["action"] == "up" and "burn" in d["reason"]
+
+
+def test_uncovered_window_never_triggers():
+    """A young series (no sample at/before t - hold) must not act —
+    two hot samples 0.5s apart are not 2s of sustained pressure."""
+    samples = [_s(10.0, depth=50.0), _s(10.5, depth=50.0)]
+    assert decide_scale(CFG, {}, samples)["action"] == "none"
+
+
+def test_max_world_clamps_scale_up():
+    samples = _series([(0, 9), (1, 9), (3, 9)], world=4)
+    assert decide_scale(CFG, {}, samples)["action"] == "none"
+
+
+# -- scale down --------------------------------------------------------
+
+def test_sustained_idleness_scales_down():
+    samples = _series([(t, 0.5) for t in range(0, 12)])
+    d = decide_scale(CFG, {}, samples)
+    assert d["action"] == "down" and d["target"] == 1
+
+
+def test_min_world_clamps_scale_down():
+    samples = _series([(t, 0.0) for t in range(0, 12)], world=1)
+    assert decide_scale(CFG, {}, samples)["action"] == "none"
+
+
+def test_shed_movement_blocks_scale_down():
+    """Fresh sheds during an otherwise idle window must not retire a
+    replica — they are pressure (the up backstop wins)."""
+    samples = [_s(t, depth=0.0, shed=(2.0 if t >= 11 else 0.0))
+               for t in range(0, 12)]
+    assert decide_scale(CFG, {}, samples)["action"] != "down"
+
+
+# -- hysteresis --------------------------------------------------------
+
+def test_cooldown_blocks_back_to_back_actions():
+    ramp = _series([(0, 9), (1, 9), (3, 9)])
+    assert decide_scale(CFG, {}, ramp)["action"] == "up"
+    held = decide_scale(CFG, {"last_action_t": 3.0}, ramp)
+    assert held["action"] == "none" and "cooldown" in held["reason"]
+
+
+def test_repair_outranks_hysteresis_but_not_cooldown():
+    """A dead replica (world below the floor) is repaired immediately —
+    no hold window needed — but still spaced by the cooldown so a
+    slow-to-join replacement is not double-launched."""
+    samples = [_s(5.0, world=0)]
+    d = decide_scale(CFG, {}, samples)
+    assert d["action"] == "up" and "min_world" in d["reason"]
+    assert decide_scale(CFG, {"last_action_t": 4.0},
+                        samples)["action"] == "none"
+
+
+def test_diurnal_oscillation_never_flaps():
+    """Load swinging between the two thresholds (above queue_low,
+    below queue_high) is the no-man's-land hysteresis exists for: no
+    suffix of the series may trigger either action."""
+    diurnal = [_s(t, depth=4.0 + 3.0 * ((t // 5) % 2))
+               for t in range(0, 40)]   # 4.0 <-> 7.0, 5s half-period
+    state = {}
+    for end in range(2, len(diurnal) + 1):
+        d = decide_scale(CFG, state, diurnal[:end])
+        assert d["action"] == "none", \
+            f"flapped at t={end - 1}: {d['reason']}"
+
+
+def test_per_rank_gauge_dict_is_summed():
+    s = _s(0)
+    s["gauges"][QUEUE_GAUGE] = {"0": 5.0, "1": 6.0}
+    samples = [s, _s(1, depth=11.0), _s(3, depth=11.0)]
+    assert decide_scale(CFG, {}, samples)["action"] == "up"
+
+
+# -- retirement pick ---------------------------------------------------
+
+def test_pick_retire_highest_slot_first():
+    assert pick_retire([0, 2, 1]) == 2
+
+
+def test_pick_retire_respects_protected_canaries():
+    assert pick_retire([0, 1, 2], protected=[2]) == 1
+    assert pick_retire([1], protected=[1]) is None
+    assert pick_retire([]) is None

@@ -351,6 +351,46 @@ def test_answered_claims_leave_pending(tmp_path):
     assert elastic.pending_joins(str(tmp_path)) == ["h-2"]
 
 
+def test_socket_sweep_spares_registered_app_ports():
+    """The parked-generation socket sweep must not cut live HTTP
+    traffic: an ESTABLISHED connection onto a registered application
+    port (a serve replica's predict listener mid-request) survives the
+    sweep, while an unregistered ephemeral<->ephemeral pair — the
+    gloo-pair shape the sweep exists for — is closed at the fd level
+    (ISSUE 19: zero-downtime through a reconfigure)."""
+    import socket
+
+    def pair():
+        lst = socket.socket()
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(1)
+        port = lst.getsockname()[1]
+        cli = socket.create_connection(("127.0.0.1", port))
+        srv, _ = lst.accept()
+        return lst, cli, srv, port
+
+    app = pair()
+    gloo = pair()
+    saved = set(elastic._app_ports)
+    try:
+        elastic.register_app_ports(app[3], 0)   # 0: ignored
+        elastic._close_stale_collective_sockets()
+        app[1].sendall(b"ping")                 # still round-trips
+        assert app[2].recv(4) == b"ping"
+        for s in (gloo[1], gloo[2]):            # fds closed under us
+            with pytest.raises(OSError):
+                os.fstat(s.fileno())
+    finally:
+        elastic._app_ports.clear()
+        elastic._app_ports.update(saved)
+        for grp in (app, gloo):
+            for s in grp[:3]:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+
 def test_join_policy_capacity_admits_all():
     admit, declined = elastic.evaluate_join_policy(
         2, ["b", "a"], "capacity", 1)

@@ -1,0 +1,267 @@
+"""The fleet front door (serving/frontdoor.py, ISSUE 19 tentpole 1).
+
+Pure layers first: ejection/readmission, routable filtering, the
+least-pending pick and fleet-level admission are clock-free functions
+over snapshots.  The live layer stands a real FrontDoor listener over
+scriptable in-process fake replicas and exercises the issue's three
+HTTP contracts: shed-with-Retry-After at the pending budget, a hung
+upstream cut off at the deadline and retried on a second replica with
+the upstream ``X-DPT-Request-Id`` preserved, and ejection after
+consecutive probe failures.
+"""
+
+import http.server
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from distributedpytorch_tpu.serving.frontdoor import (FrontDoor,
+                                                      admission,
+                                                      decide_health,
+                                                      pick_upstream,
+                                                      routable_ids)
+
+# -- pure policy -------------------------------------------------------
+
+
+def _rep(uid, fails=0, age=None, ejected=False, alive=True,
+         draining=False):
+    return {"id": uid, "alive": alive, "ejected": ejected,
+            "draining": draining, "consecutive_failures": fails,
+            "last_step_age_s": age}
+
+
+def test_decide_health_ejects_on_failure_streak():
+    cfg = {"eject_after": 3}
+    assert decide_health(cfg, [_rep(0, fails=2)]) == []
+    out = decide_health(cfg, [_rep(0, fails=3)])
+    assert out == [{"id": 0, "action": "eject",
+                    "reason": "3 consecutive failures"}]
+
+
+def test_decide_health_ejects_on_stale_age_only_when_enabled():
+    stale = [_rep(0, age=99.0)]
+    assert decide_health({"max_step_age_s": 0.0}, stale) == []
+    out = decide_health({"max_step_age_s": 30.0}, stale)
+    assert out[0]["action"] == "eject" and "stale" in out[0]["reason"]
+
+
+def test_decide_health_readmits_on_recovery():
+    cfg = {"eject_after": 3, "max_step_age_s": 30.0}
+    out = decide_health(cfg, [_rep(0, fails=0, ejected=True)])
+    assert out[0]["action"] == "readmit"
+    # still failing, or still stale: stays out
+    assert decide_health(cfg, [_rep(0, fails=1, ejected=True)]) == []
+    assert decide_health(cfg, [_rep(0, age=99.0, ejected=True)]) == []
+
+
+def test_routable_ids_filters_dead_ejected_draining():
+    snaps = [_rep(0), _rep(1, ejected=True), _rep(2, alive=False),
+             _rep(3, draining=True), _rep(4)]
+    assert routable_ids(snaps) == [0, 4]
+
+
+def test_pick_upstream_least_pending_with_rr_tiebreak():
+    assert pick_upstream([0, 1, 2], {0: 3, 1: 0, 2: 1}, rr=0) == 1
+    # all tied: round-robin walks the pool deterministically
+    picks = [pick_upstream([0, 1, 2], {}, rr=r) for r in range(4)]
+    assert picks == [0, 1, 2, 0]
+    assert pick_upstream([0, 1], {}, rr=0, exclude=[0]) == 1
+    assert pick_upstream([0], {}, rr=0, exclude=[0]) is None
+    assert pick_upstream([], {}, rr=0) is None
+
+
+def test_admission_budget():
+    cfg = {"pending_budget": 2, "retry_after_s": 1.5}
+    assert admission(cfg, 1) == {"admit": True, "retry_after_s": 0.0}
+    assert admission(cfg, 2) == {"admit": False, "retry_after_s": 1.5}
+
+
+# -- live front door over fake replicas --------------------------------
+
+class FakeReplica:
+    """A scriptable serve replica: ``behavior(hit_n) -> (status,
+    payload)`` answers /predict (optionally sleeping first via
+    ``delay_s``); /livez reports a stats-shaped health body."""
+
+    def __init__(self, behavior=None, delay_s=0.0):
+        self.behavior = behavior or (lambda n: (200, {"label": 1}))
+        self.delay_s = delay_s
+        self.hits = 0
+        rep = self
+
+        class _H(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                rep.hits += 1
+                if rep.delay_s:
+                    time.sleep(rep.delay_s)
+                status, payload = rep.behavior(rep.hits)
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("X-DPT-Request-Id",
+                                 f"r7-{rep.hits:06d}")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                body = json.dumps({"ok": True, "queue_depth": 0,
+                                   "draining": False,
+                                   "checkpoint": None}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self.server = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), _H)
+        self.server.daemon_threads = True
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _mk_fd(ports, **kw):
+    replicas = {i: {"predict_port": p, "health_port": p,
+                    "health_path": "/livez"}
+                for i, p in enumerate(ports)}
+    kw.setdefault("upstream_timeout_s", 2.0)
+    kw.setdefault("probe_timeout_s", 1.0)
+    kw.setdefault("interval_s", 0.05)
+    fd = FrontDoor(0, replicas, **kw)
+    fd.start()
+    return fd
+
+
+def _post(port, timeout=10.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps({"image": [[0]]}).encode())
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def test_frontdoor_round_trip_preserves_request_id():
+    rep = FakeReplica()
+    fd = _mk_fd([rep.port])
+    try:
+        fd.tick()  # probe marks the replica alive
+        status, body, headers = _post(fd.port)
+        assert status == 200 and body["label"] == 1
+        assert headers["X-DPT-Request-Id"] == "r7-000001"
+        assert headers["X-DPT-Upstream"] == "0"
+        doc = fd.status_doc()
+        assert doc["answered"] == 1
+        assert doc["upstreams"]["0"]["requests"] == 1
+    finally:
+        fd.close()
+        rep.close()
+
+
+def test_frontdoor_sheds_at_pending_budget_with_retry_after():
+    rep = FakeReplica()
+    fd = _mk_fd([rep.port], policy={"pending_budget": 0,
+                                    "retry_after_s": 2.5})
+    try:
+        fd.tick()
+        status, body, headers = _post(fd.port)
+        assert status == 503 and "capacity" in body["error"]
+        assert headers["Retry-After"] == "2.5"
+        assert fd.status_doc()["shed"] == 1
+        assert rep.hits == 0   # shed BEFORE touching any upstream
+    finally:
+        fd.close()
+        rep.close()
+
+
+def test_frontdoor_hung_upstream_deadline_then_retry_on_second():
+    """The issue's hung-replica contract: the first attempt is cut off
+    at upstream_timeout_s, the SAME request retries on the other
+    replica, and the client sees its 200 — with the answering
+    replica's request id."""
+    hung = FakeReplica(delay_s=10.0)
+    good = FakeReplica()
+    fd = _mk_fd([hung.port, good.port], upstream_timeout_s=0.4)
+    try:
+        fd.tick()
+        # pin the first pick to the hung replica: round-robin over a
+        # fresh tie starts at slot rr % 2 == 0
+        t0 = time.monotonic()
+        status, _, headers = _post(fd.port)
+        elapsed = time.monotonic() - t0
+        assert status == 200
+        assert headers["X-DPT-Upstream"] == "1"
+        assert headers["X-DPT-Request-Id"].startswith("r7-")
+        assert elapsed < 5.0   # deadline cut the hang, not the client
+        doc = fd.status_doc()
+        assert doc["retries"] == 1
+        assert doc["upstreams"]["0"]["errors"] == 1  # unreachable
+    finally:
+        fd.close()
+        hung.close()
+        good.close()
+
+
+def test_frontdoor_5xx_retries_once_on_another_replica():
+    bad = FakeReplica(behavior=lambda n: (500, {"error": "boom"}))
+    good = FakeReplica()
+    fd = _mk_fd([bad.port, good.port])
+    try:
+        fd.tick()
+        codes = {_post(fd.port)[0] for _ in range(4)}
+        assert codes == {200}   # every request lands on the good one
+        doc = fd.status_doc()
+        assert doc["retries"] >= 1
+        assert doc["upstreams"]["0"]["errors"] >= 1
+    finally:
+        fd.close()
+        bad.close()
+        good.close()
+
+
+def test_frontdoor_no_routable_replica_answers_503():
+    fd = _mk_fd([1])  # port 1: nothing listening, never probed alive
+    try:
+        status, body, headers = _post(fd.port)
+        assert status == 503 and "no routable" in body["error"]
+        assert "Retry-After" in headers
+        assert fd.status_doc()["no_upstream"] == 1
+    finally:
+        fd.close()
+
+
+def test_frontdoor_ejects_dead_replica_and_keeps_serving():
+    dying = FakeReplica()
+    good = FakeReplica()
+    fd = _mk_fd([dying.port, good.port],
+                policy={"eject_after": 2})
+    try:
+        fd.tick()
+        assert routable_ids(
+            [u.snapshot() for u in fd._ups.values()]) == [0, 1]
+        dying.close()
+        for _ in range(3):
+            fd.tick()
+        snaps = [u.snapshot() for u in fd._ups.values()]
+        assert routable_ids(snaps) == [1]
+        assert fd.status_doc()["upstreams"]["0"]["ejected"]
+        # clients never notice: every request routes to the survivor
+        assert _post(fd.port)[0] == 200
+    finally:
+        fd.close()
+        good.close()

@@ -1497,6 +1497,84 @@ def test_mesh_axis_cross_file_clean_negative(tmp_path):
                  rule="mesh-axis-propagation") == []
 
 
+# -- rule 20: outbound-call-without-timeout ----------------------------
+
+_OUTBOUND_BAD = """
+    import socket
+    import urllib.request
+    from http.client import HTTPConnection
+
+    def probe(url, host, port):
+        raw = urllib.request.urlopen(url).read()
+        conn = HTTPConnection(host, port)
+        sock = socket.create_connection((host, port))
+        return raw, conn, sock
+"""
+
+_OUTBOUND_GOOD = """
+    import socket
+    import urllib.request
+    from http.client import HTTPConnection
+
+    def probe(url, host, port):
+        raw = urllib.request.urlopen(url, timeout=2.0).read()
+        conn = HTTPConnection(host, port, timeout=5.0)
+        sock = socket.create_connection((host, port), 1.5)
+        return raw, conn, sock
+"""
+
+
+def test_outbound_timeout_positive(tmp_path):
+    found = _lint(tmp_path, {"fleet.py": _OUTBOUND_BAD},
+                  rule="outbound-call-without-timeout")
+    assert len(found) == 3
+    assert "blocks forever" in found[0].message
+
+
+def test_outbound_timeout_negative(tmp_path):
+    assert _lint(tmp_path, {"fleet.py": _OUTBOUND_GOOD},
+                 rule="outbound-call-without-timeout") == []
+
+
+def test_outbound_timeout_none_literal_counts(tmp_path):
+    src = """
+        import urllib.request
+
+        def probe(url):
+            return urllib.request.urlopen(url, timeout=None).read()
+    """
+    found = _lint(tmp_path, {"frontdoor.py": src},
+                  rule="outbound-call-without-timeout")
+    assert len(found) == 1  # timeout=None is the block-forever spelling
+
+
+def test_outbound_timeout_scoped_to_control_plane(tmp_path):
+    # a training-side module may legitimately block (e.g. a dataset
+    # download) — the rule only owns serving/fleet/controller code
+    assert _lint(tmp_path, {"datasets.py": _OUTBOUND_BAD},
+                 rule="outbound-call-without-timeout") == []
+
+
+def test_outbound_timeout_serving_dir_targeted(tmp_path):
+    os.makedirs(tmp_path / "serving", exist_ok=True)
+    found = _lint(tmp_path,
+                  {os.path.join("serving", "proxy.py"): _OUTBOUND_BAD},
+                  rule="outbound-call-without-timeout")
+    assert len(found) == 3
+
+
+def test_outbound_timeout_rationale_escape(tmp_path):
+    src = """
+        import urllib.request
+
+        def probe(url):
+            # bounded by the caller's socket.setdefaulttimeout at init
+            return urllib.request.urlopen(url).read()
+    """
+    assert _lint(tmp_path, {"rollout.py": src},
+                 rule="outbound-call-without-timeout") == []
+
+
 # -- whole-program CLI contract ----------------------------------------
 
 def test_json_output_lists_active_rules(tmp_path, capsys):
@@ -1507,6 +1585,7 @@ def test_json_output_lists_active_rules(tmp_path, capsys):
     payload = json.loads(capsys.readouterr().out)
     for name in ("collective-divergence", "lock-order-cycle",
                  "mesh-axis-propagation", "host-sync-in-step-loop",
+                 "outbound-call-without-timeout",
                  "bad-suppression"):
         assert name in payload["rules"]
 

@@ -1,0 +1,257 @@
+"""Canary rollout policy + manager (serving/rollout.py, ISSUE 19
+tentpole 3).
+
+The pure verdict (``decide_rollout``) and the canary pick are driven
+with synthetic observations; the ledger readers get real tmp files;
+``RolloutManager`` runs its whole stable -> canary -> promote/rollback
+state machine against stub reload/event functions — no sockets, no
+replicas, no JAX.
+"""
+
+import hashlib
+import json
+
+from distributedpytorch_tpu.serving.rollout import (LINEAGE_FILE,
+                                                    RolloutManager,
+                                                    choose_canaries,
+                                                    decide_rollout,
+                                                    newest_lineage_entry,
+                                                    verify_sha)
+
+# -- canary pick -------------------------------------------------------
+
+
+def test_choose_canaries_fraction_at_least_one_never_all():
+    assert choose_canaries([0, 1], 0.34) == [0]
+    assert choose_canaries([0, 1, 2], 0.34) == [0]     # a third is ONE
+    assert choose_canaries(range(6), 0.34) == [0, 1]
+    assert choose_canaries([0, 1, 2], 1.0) == [0, 1]   # never the fleet
+    assert choose_canaries([0], 0.5) == []             # no stable side
+    assert choose_canaries([], 0.5) == []
+
+
+def test_choose_canaries_deterministic_over_unsorted_ids():
+    assert choose_canaries([2, 0, 1], 0.34) == [0]
+
+
+# -- pure verdict ------------------------------------------------------
+
+CFG = {"hold_s": 5.0, "min_requests": 20, "max_error_ratio": 0.05,
+       "error_ratio_factor": 3.0, "p95_factor": 3.0,
+       "p95_floor_ms": 50.0, "timeout_s": 120.0}
+
+
+def _obs(t, creq=0, cerr=0, sreq=100, serr=0, cp95=None, sp95=None,
+         alive=True):
+    return {"t": t, "canary_alive": alive,
+            "canary": {"requests": creq, "errors": cerr, "p95_ms": cp95},
+            "stable": {"requests": sreq, "errors": serr, "p95_ms": sp95}}
+
+
+def test_verdict_dead_canary_rolls_back():
+    v = decide_rollout(CFG, {"since_t": 0.0}, _obs(1.0, alive=False))
+    assert v["action"] == "rollback" and "died" in v["reason"]
+
+
+def test_verdict_error_ratio_rolls_back():
+    v = decide_rollout(CFG, {"since_t": 0.0},
+                       _obs(2.0, creq=40, cerr=10, sreq=100, serr=1))
+    assert v["action"] == "rollback" and "error ratio" in v["reason"]
+
+
+def test_verdict_tolerates_fleetwide_errors():
+    """Canary errors that merely MATCH stable's are not the canary's
+    fault — no rollback when stable is equally unhealthy."""
+    v = decide_rollout(CFG, {"since_t": 0.0},
+                       _obs(2.0, creq=40, cerr=4, sreq=100, serr=10))
+    assert v["action"] != "rollback"
+
+
+def test_verdict_p95_regression_rolls_back():
+    v = decide_rollout(CFG, {"since_t": 0.0},
+                       _obs(2.0, creq=40, cp95=400.0, sp95=50.0))
+    assert v["action"] == "rollback" and "p95" in v["reason"]
+
+
+def test_verdict_p95_noise_floor_ignored():
+    v = decide_rollout(CFG, {"since_t": 0.0},
+                       _obs(2.0, creq=40, cp95=40.0, sp95=5.0))
+    assert v["action"] != "rollback"  # 40ms is under the 50ms floor
+
+
+def test_verdict_promotes_after_healthy_hold():
+    assert decide_rollout(CFG, {"since_t": 0.0},
+                          _obs(3.0, creq=40))["action"] == "continue"
+    v = decide_rollout(CFG, {"since_t": 0.0}, _obs(6.0, creq=40))
+    assert v["action"] == "promote"
+
+
+def test_verdict_starved_canary_times_out():
+    assert decide_rollout(CFG, {"since_t": 0.0},
+                          _obs(60.0, creq=3))["action"] == "continue"
+    v = decide_rollout(CFG, {"since_t": 0.0}, _obs(121.0, creq=3))
+    assert v["action"] == "rollback" and "min_requests" in v["reason"]
+
+
+# -- ledger readers ----------------------------------------------------
+
+def _write_ledger(tmp_path, entries):
+    recs = []
+    for name, epoch, content in entries:
+        p = tmp_path / name
+        p.write_bytes(content)
+        recs.append({"file": name, "epoch": epoch,
+                     "sha256": hashlib.sha256(content).hexdigest(),
+                     "bytes": len(content)})
+    (tmp_path / LINEAGE_FILE).write_text(json.dumps({"records": recs}))
+    return recs
+
+
+def test_newest_lineage_entry_highest_epoch_wins(tmp_path):
+    _write_ledger(tmp_path, [("a.ckpt", 1, b"old"),
+                             ("b.ckpt", 3, b"new"),
+                             ("c.ckpt", 2, b"mid")])
+    head = newest_lineage_entry(str(tmp_path))
+    assert head["file"] == "b.ckpt" and head["epoch"] == 3
+    assert head["path"].endswith("b.ckpt")
+
+
+def test_newest_lineage_entry_skips_missing_files(tmp_path):
+    _write_ledger(tmp_path, [("a.ckpt", 1, b"old"),
+                             ("gone.ckpt", 9, b"x")])
+    (tmp_path / "gone.ckpt").unlink()
+    assert newest_lineage_entry(str(tmp_path))["file"] == "a.ckpt"
+
+
+def test_newest_lineage_entry_none_without_ledger(tmp_path):
+    assert newest_lineage_entry(str(tmp_path)) is None
+    (tmp_path / LINEAGE_FILE).write_text("not json {")
+    assert newest_lineage_entry(str(tmp_path)) is None
+
+
+def test_verify_sha_content_check(tmp_path):
+    p = tmp_path / "m.ckpt"
+    p.write_bytes(b"payload")
+    good = hashlib.sha256(b"payload").hexdigest()
+    assert verify_sha(str(p), good)
+    assert not verify_sha(str(p), "0" * 64)
+    assert not verify_sha(str(tmp_path / "missing"), good)
+
+
+# -- the manager's state machine ---------------------------------------
+
+class _Fleet:
+    """Stub fleet: replica snapshots + a reload log."""
+
+    def __init__(self, tmp_path, n=3):
+        self.stable = _write_ledger(tmp_path, [("v1.ckpt", 1, b"v1")])[0]
+        self.stable["path"] = str(tmp_path / "v1.ckpt")
+        self.reps = [
+            {"id": i, "alive": True, "ejected": False, "draining": False,
+             "lineage": {"sha256": self.stable["sha256"],
+                         "path": self.stable["path"]},
+             "requests": 0, "errors": 0, "p95_ms": 10.0}
+            for i in range(n)]
+        self.reloads = []
+        self.reload_ok = True
+        self.events = []
+
+    def reload_fn(self, uid, path):
+        self.reloads.append((uid, path))
+        return self.reload_ok
+
+    def event_fn(self, name, **attrs):
+        self.events.append((name, attrs))
+
+    def head(self, tmp_path, name="v2.ckpt", epoch=2, content=b"v2"):
+        rec = _write_ledger(tmp_path, [(name, epoch, content)])[0]
+        return dict(rec, path=str(tmp_path / name))
+
+
+def _mk(tmp_path, n=3, **cfg):
+    fleet = _Fleet(tmp_path, n=n)
+    base = {"fraction": 0.34, "hold_s": 5.0, "min_requests": 20,
+            "timeout_s": 120.0}
+    base.update(cfg)
+    mgr = RolloutManager(base, fleet.reload_fn, fleet.event_fn)
+    return fleet, mgr
+
+
+def test_manager_learns_stable_and_ignores_current_head(tmp_path):
+    fleet, mgr = _mk(tmp_path)
+    head = dict(fleet.stable)
+    mgr.tick(0.0, fleet.reps, head)
+    assert mgr.stable_sha == fleet.stable["sha256"]
+    assert mgr.phase == "stable" and fleet.reloads == []
+
+
+def test_manager_canary_then_promote(tmp_path):
+    fleet, mgr = _mk(tmp_path)
+    mgr.tick(0.0, fleet.reps, dict(fleet.stable))
+    head = fleet.head(tmp_path)
+    mgr.tick(1.0, fleet.reps, head)
+    assert mgr.phase == "canary" and mgr.canary_ids == [0]
+    assert fleet.reloads == [(0, head["path"])]
+    assert fleet.events[0][0] == "rollout/canary_start"
+    # healthy canary traffic accumulates...
+    for rep in fleet.reps:
+        rep["requests"] = 50
+    mgr.tick(3.0, fleet.reps, head)
+    assert mgr.phase == "canary"  # hold_s not yet served
+    mgr.tick(7.0, fleet.reps, head)
+    assert mgr.phase == "stable" and mgr.promotions == 1
+    assert mgr.stable_sha == head["sha256"]
+    # the stable side was reloaded onto the candidate
+    assert sorted(u for u, _ in fleet.reloads[1:]) == [1, 2]
+    assert fleet.events[-1][0] == "rollout/promote"
+
+
+def test_manager_bad_canary_rolls_back_and_never_retries(tmp_path):
+    fleet, mgr = _mk(tmp_path)
+    mgr.tick(0.0, fleet.reps, dict(fleet.stable))
+    head = fleet.head(tmp_path)
+    mgr.tick(1.0, fleet.reps, head)
+    assert mgr.phase == "canary"
+    fleet.reloads.clear()
+    # the canary replica starts erroring hard
+    fleet.reps[0]["requests"] = 40
+    fleet.reps[0]["errors"] = 20
+    for rep in fleet.reps[1:]:
+        rep["requests"] = 40
+    mgr.tick(2.0, fleet.reps, head)
+    assert mgr.phase == "stable" and mgr.rollbacks == 1
+    assert fleet.reloads == [(0, fleet.stable["path"])]  # restored
+    assert mgr.stable_sha == fleet.stable["sha256"]
+    assert fleet.events[-1][0] == "rollout/rollback"
+    # the rejected sha must not canary-loop
+    mgr.tick(3.0, fleet.reps, head)
+    assert mgr.phase == "stable" and fleet.reloads == \
+        [(0, fleet.stable["path"])]
+
+
+def test_manager_rejects_checksum_mismatch(tmp_path):
+    fleet, mgr = _mk(tmp_path)
+    mgr.tick(0.0, fleet.reps, dict(fleet.stable))
+    head = fleet.head(tmp_path)
+    (tmp_path / "v2.ckpt").write_bytes(b"torn")   # rotate under it
+    mgr.tick(1.0, fleet.reps, head)
+    assert mgr.phase == "stable" and fleet.reloads == []
+    assert fleet.events[-1][0] == "rollout/candidate_rejected"
+    assert head["sha256"] in mgr.rejected
+
+
+def test_manager_failed_reload_rejects_candidate(tmp_path):
+    fleet, mgr = _mk(tmp_path)
+    fleet.reload_ok = False
+    mgr.tick(0.0, fleet.reps, dict(fleet.stable))
+    head = fleet.head(tmp_path)
+    mgr.tick(1.0, fleet.reps, head)
+    assert mgr.phase == "stable"
+    assert fleet.events[-1][0] == "rollout/candidate_rejected"
+
+
+def test_manager_single_replica_fleet_never_canaries(tmp_path):
+    fleet, mgr = _mk(tmp_path, n=1)
+    mgr.tick(0.0, fleet.reps, dict(fleet.stable))
+    mgr.tick(1.0, fleet.reps, fleet.head(tmp_path))
+    assert mgr.phase == "stable" and fleet.reloads == []

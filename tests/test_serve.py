@@ -323,6 +323,120 @@ def test_tier_max_requests_stops_driver():
         tier.close()
 
 
+# -- control plane: drain + hot-swap (ISSUE 19) -------------------------
+
+def _admin(port, path, doc=None, timeout=5.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(doc if doc is not None else {}).encode())
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_tier_drain_closes_admissions_and_driver_exits():
+    tier = _make_tier()
+    tier.start()
+    driver = _serve_in_thread(tier)
+    try:
+        img = np.full(SHAPE, 5, np.uint8).tolist()
+        assert _post(tier.port, img)[0] == 200
+        status, body = _admin(tier.port, "/admin/drain")
+        assert status == 200 and body["draining"]
+        # draining sheds new work with an answer, never a hang
+        status, body = _post(tier.port, img)
+        assert status == 503 and "draining" in body["error"]
+        # ...and the driver exits once the queue is flushed
+        driver.join(timeout=5)
+        assert not driver.is_alive()
+        assert tier.stats()["draining"]
+    finally:
+        tier.close()
+
+
+def test_tier_reload_answers_501_without_swap_fn():
+    tier = _make_tier()
+    tier.start()
+    try:
+        status, body = _admin(tier.port, "/admin/reload",
+                              {"checkpoint": "/tmp/x.ckpt"})
+        assert status == 501 and "swap_fn" in body["error"]
+    finally:
+        tier.close()
+
+
+def test_tier_reload_rejects_bad_body():
+    tier = _make_tier()
+    tier.set_swap_fn(lambda path: (_stub_infer, None))
+    tier.start()
+    try:
+        status, body = _admin(tier.port, "/admin/reload",
+                              {"not_checkpoint": True})
+        assert status == 400 and "bad reload request" in body["error"]
+    finally:
+        tier.close()
+
+
+def test_tier_hot_swap_switches_infer_and_lineage():
+    """The zero-downtime contract: /admin/reload swaps the predict
+    program between batches — the listener never closes, the answer
+    changes, and the served lineage (stats + /livez) follows."""
+    def swapped_infer(arr):
+        return (np.full((arr.shape[0],), 42, np.int32),
+                np.full((arr.shape[0],), 0.9, np.float64))
+
+    info = {"file": "v2.ckpt", "sha256": "c0ffee" * 10 + "beef",
+            "epoch": 2, "path": "/tmp/v2.ckpt"}
+    tier = _make_tier()
+    tier.set_checkpoint({"file": "v1.ckpt", "sha256": "a" * 64,
+                         "epoch": 1})
+    tier.set_swap_fn(lambda path: (swapped_infer, dict(info,
+                                                       path=path)))
+    tier.start()
+    driver = _serve_in_thread(tier)
+    try:
+        img = np.full(SHAPE, 7, np.uint8).tolist()
+        assert _post(tier.port, img)[1]["label"] == 7   # old program
+        status, body = _admin(tier.port, "/admin/reload",
+                              {"checkpoint": "/tmp/v2.ckpt"})
+        assert status == 200 and body["reloaded"]
+        assert body["checkpoint"]["epoch"] == 2
+        assert _post(tier.port, img)[1]["label"] == 42  # new program
+        assert tier.stats()["checkpoint"]["file"] == "v2.ckpt"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{tier.port}/livez", timeout=5) as r:
+            live = json.loads(r.read())
+        assert live["checkpoint"]["sha256"].startswith("c0ffee")
+    finally:
+        tier.close()
+        driver.join(timeout=5)
+
+
+def test_tier_failed_swap_answers_500_and_keeps_old_program():
+    def bad_swap(path):
+        raise ValueError(f"lineage verification failed for {path}")
+
+    tier = _make_tier()
+    tier.set_checkpoint({"file": "v1.ckpt", "sha256": "a" * 64})
+    tier.set_swap_fn(bad_swap)
+    tier.start()
+    driver = _serve_in_thread(tier)
+    try:
+        img = np.full(SHAPE, 3, np.uint8).tolist()
+        status, body = _admin(tier.port, "/admin/reload",
+                              {"checkpoint": "/tmp/torn.ckpt"})
+        assert status == 500
+        assert "lineage verification failed" in body["error"]
+        # the old program is untouched and still answering
+        assert _post(tier.port, img)[1]["label"] == 3
+        assert tier.stats()["checkpoint"]["file"] == "v1.ckpt"
+    finally:
+        tier.close()
+        driver.join(timeout=5)
+
+
 # -- JAX-backed contracts ----------------------------------------------
 
 @pytest.fixture(scope="module")

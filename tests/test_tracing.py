@@ -214,3 +214,21 @@ def test_timeline_gains_request_track(tmp_path, tracer):
              if e["ph"] == "M" and e["name"] == "thread_name"
              and e["pid"] == 0]
     assert "requests" in names
+
+
+def test_records_carry_serving_lineage_when_set(tracer):
+    """ISSUE 19 satellite: after set_lineage (startup or hot-swap),
+    every record names WHICH checkpoint version answered."""
+    t1 = tracer.start()
+    t1.finish(200, "answered")
+    tracer.set_lineage("c0ffee" * 10 + "beef")
+    t2 = tracer.start()
+    t2.finish(200, "answered")
+    recs = tracing.load_records(tracer.path.rsplit("/", 1)[0])
+    assert "lineage" not in recs[0]
+    assert recs[1]["lineage"] == "c0ffeec0ffee"[:12]
+    tracer.set_lineage(None)
+    t3 = tracer.start()
+    t3.finish(200, "answered")
+    recs = tracing.load_records(tracer.path.rsplit("/", 1)[0])
+    assert "lineage" not in recs[2]
