@@ -2151,6 +2151,123 @@ class OutboundCallWithoutTimeout(Rule):
                     f"call is bounded elsewhere")
 
 
+class NondeterminismInPolicy(Rule):
+    """The fleet simulator (sim/) replays the REAL control-plane
+    policies under a virtual clock, and same-seed runs must produce
+    byte-identical event logs — which only holds while the deciders
+    stay pure functions of (config, sample window).  One ``time.time()``
+    or unseeded RNG draw inside a decider silently forks the simulated
+    fleet from the live one AND breaks replay determinism, the two
+    properties the ISSUE-20 gate rests on.  In the pure decider modules
+    (slo.py, serving/{planner,controller,rollout}.py and everything
+    under sim/), findings are:
+
+      * importing ``time`` or ``datetime`` at all — a pure decider has
+        no business holding a clock; samples carry their own ``t``;
+      * wall/monotonic clock calls (``time.*``, ``datetime.now`` /
+        ``utcnow`` / ``today``);
+      * ambient entropy: ``os.urandom``, ``uuid.uuid4``, ``secrets.*``,
+        module-global ``random.<draw>()``, and zero-arg
+        ``random.Random()`` (seeded from the OS clock).
+
+    ``random.Random(seed)`` WITH an argument is allowed — a seeded
+    stream is part of the deterministic replay, not entropy.  In
+    serving/frontdoor.py (a live process with legitimate clocks in its
+    serving loop) only the pure decision helpers the simulator composes
+    are held to this: decide_health / routable_ids / pick_upstream /
+    admission.  Deliberate exceptions carry a rationale comment on the
+    line or the line above."""
+
+    name = "nondeterminism-in-policy"
+    description = ("wall clock / ambient entropy inside a pure decider "
+                   "module (slo, planner, controller, rollout, sim/) — "
+                   "policies must stay pure functions of (config, "
+                   "samples) or the fleet simulator's byte-identical "
+                   "replay contract breaks")
+
+    TARGET_BASENAMES = {"slo.py", "planner.py", "controller.py",
+                        "rollout.py"}
+    FRONTDOOR_FUNCS = {"decide_health", "routable_ids", "pick_upstream",
+                       "admission"}
+    _CLOCK_IMPORTS = {"time", "datetime"}
+    _DT_CALLS = {"now", "utcnow", "today"}
+
+    _has_rationale = BlockingH2dInStepLoop._has_rationale
+
+    def _whole_module(self, mod: Module) -> bool:
+        rel = mod.rel.replace("\\", "/").split("/")
+        return mod.basename in self.TARGET_BASENAMES or "sim" in rel[:-1]
+
+    def _frontdoor_lines(self, mod: Module) -> List[Tuple[int, int]]:
+        return [(fn.lineno, fn.end_lineno or fn.lineno)
+                for fn in mod.index.functions
+                if getattr(fn, "name", "") in self.FRONTDOOR_FUNCS]
+
+    def _bad_call(self, call: ast.Call) -> Optional[str]:
+        """The impure callable's dotted name, or None."""
+        cn = call_name(call)
+        last, root = last_seg(cn), root_seg(cn)
+        if root == "time":
+            return cn
+        if root in ("datetime", "dt") and last in self._DT_CALLS:
+            return cn
+        if cn == "os.urandom" or cn == "uuid.uuid4" or root == "secrets":
+            return cn
+        if root == "random":
+            if last == "Random":
+                # Seeded stream = deterministic; zero-arg = OS entropy.
+                return None if (call.args or call.keywords) else cn
+            return cn
+        return None
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            whole = self._whole_module(mod)
+            spans = [] if whole else (
+                self._frontdoor_lines(mod)
+                if mod.basename == "frontdoor.py" else None)
+            if not whole and spans is None:
+                continue
+
+            def targeted(line: int) -> bool:
+                return whole or any(lo <= line <= hi
+                                    for lo, hi in spans)
+
+            for node in mod.index.nodes:
+                if whole and isinstance(node, (ast.Import,
+                                               ast.ImportFrom)):
+                    names = ([a.name for a in node.names]
+                             if isinstance(node, ast.Import)
+                             else [node.module or ""])
+                    hit = [n for n in names
+                           if n.split(".")[0] in self._CLOCK_IMPORTS]
+                    if hit and not self._has_rationale(mod, node.lineno):
+                        yield self.finding(
+                            mod, node.lineno,
+                            f"import of {hit[0]!r} in a pure decider "
+                            f"module: deciders take time from their "
+                            f"samples (each carries its own 't'), "
+                            f"never from a clock — the fleet "
+                            f"simulator's byte-identical replay "
+                            f"depends on it")
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                cn = self._bad_call(node)
+                if cn is None or not targeted(node.lineno):
+                    continue
+                if self._has_rationale(mod, node.lineno):
+                    continue
+                yield self.finding(
+                    mod, node.lineno,
+                    f"{cn}() inside pure policy code: this decider "
+                    f"runs under the fleet simulator's virtual clock, "
+                    f"where wall time and ambient entropy silently "
+                    f"fork the replay — take t from the sample window, "
+                    f"or thread a seeded random.Random through the "
+                    f"config")
+
+
 RULES = (
     HostSyncInStepLoop(),
     TraceImpurity(),
@@ -2172,6 +2289,7 @@ RULES = (
     LockOrderCycle(),
     MeshAxisPropagation(),
     OutboundCallWithoutTimeout(),
+    NondeterminismInPolicy(),
 )
 
 RULES_BY_NAME = {r.name: r for r in RULES}

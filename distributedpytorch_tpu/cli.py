@@ -612,7 +612,8 @@ def run_train(cfg: Config) -> dict:
                 "normal elastic member and must keep reconfiguring with "
                 "its world")
         join_info = runtime.join_distributed(
-            cfg.elastic_dir or elastic.default_elastic_dir(cfg.rsl_path))
+            cfg.elastic_dir or elastic.default_elastic_dir(cfg.rsl_path),
+            timeout_s=cfg.elastic_join_wait)
     else:
         runtime.initialize_distributed(elastic=cfg.elastic)
     if cfg.elastic:
@@ -1524,7 +1525,8 @@ def run_serve(cfg: Config) -> dict:
                 "becomes a normal elastic member and must keep "
                 "reconfiguring with its world")
         join_info = runtime.join_distributed(
-            cfg.elastic_dir or elastic.default_elastic_dir(cfg.rsl_path))
+            cfg.elastic_dir or elastic.default_elastic_dir(cfg.rsl_path),
+            timeout_s=cfg.elastic_join_wait)
     else:
         runtime.initialize_distributed(elastic=cfg.elastic)
     if cfg.elastic:
@@ -1780,6 +1782,17 @@ def main(argv=None) -> int:
 
         print(slo.incidents_report(cfg.rsl_path))
         return 0
+    if cfg.action == "sim":
+        # Deterministic fleet simulator (sim/): replay a scenario
+        # against the real control-plane policies under a virtual
+        # clock — no JAX backend, no sockets, no wall clock.
+        from .sim import runner as sim_runner
+
+        try:
+            return sim_runner.run_cli(cfg)
+        except ValueError as e:
+            logging.error(f"{e}, exiting...")
+            return 1
     if cfg.action == "bench-trend":
         # Regression ledger over the checked-in BENCH history; the
         # verdict gates CI (exit 1 on a fresh-vs-fresh regression).

@@ -234,6 +234,11 @@ class Config:
     elastic_target: str = "capacity"
     elastic_min_world: int = 1
     elastic_join: bool = False
+    # How long a joiner polls for the coordinator's admit/decline
+    # verdict before giving up (emits elastic/join_wait_timeout, then
+    # raises).  Must dominate an epoch plus a reconfigure window —
+    # survivors only scan claims at health boundaries.
+    elastic_join_wait: float = 600.0
     # Rolling-checkpoint lineage depth: how many per-epoch snapshots are
     # retained (1 = the reference delete-previous behavior; >1 gives the
     # corruption-fallback resume earlier snapshots to walk back to).
@@ -244,6 +249,15 @@ class Config:
     lint_paths: tuple = ()
     lint_changed_only: bool = False   # findings only in git-changed files
     lint_base: str = ""               # --changed-only diff base ref
+    # 'sim' subcommand (sim/ fleet simulator): scenario name or JSON
+    # path, seed, and optional overrides of the scenario's fleet size /
+    # virtual duration / latency-model file.  Artifacts land under
+    # rsl_path in the same JSONL schemas live runs write.
+    sim_scenario: str = "control"
+    sim_seed: int = 0
+    sim_replicas: int = 0        # 0 = scenario default
+    sim_duration: float = 0.0    # virtual seconds; 0 = scenario default
+    sim_model: Optional[str] = None  # latency-model JSON override
     # Flight recorder (flightrec.py, ISSUE 7): a fixed-memory per-rank
     # ring buffer of per-step records (step/dispatch/data-wait times,
     # queue depth, retry/fault events) dumped to
@@ -541,6 +555,13 @@ def _common_args(p: argparse.ArgumentParser) -> None:
                         "admit/decline verdict, and enter the grown "
                         "world at the rank it assigns (fresh capacity "
                         "or a departed rank restarting)")
+    p.add_argument("--elastic-join-wait", type=float, default=600.0,
+                   dest="elasticJoinWait", metavar="S",
+                   help="how long a joiner waits for the coordinator's "
+                        "admit/decline verdict before emitting "
+                        "elastic/join_wait_timeout and giving up; must "
+                        "dominate an epoch plus a reconfigure window "
+                        "(default 600)")
     p.add_argument("--keep-ckpts", type=int, default=1, dest="keepCkpts",
                    metavar="K",
                    help="rolling-checkpoint lineage depth: retain the K "
@@ -1035,6 +1056,38 @@ def build_parser() -> argparse.ArgumentParser:
                        help=f"run directory holding incident-*.json "
                             f"(default: {RSL_PATH})")
 
+    # Deterministic fleet simulator (sim/) — virtual clock, no JAX
+    # backend, no sockets; composes the real policy deciders at N=100+.
+    p_sim = sub.add_parser(
+        "sim", help="run a seeded fleet-scale scenario through the real "
+                    "control-plane policies and emit live-run JSONL "
+                    "artifacts (see scripts/sim_gate.py)")
+    p_sim.add_argument("--rsl_path", type=str, default=RSL_PATH,
+                       help=f"artifact output directory "
+                            f"(default: {RSL_PATH})")
+    p_sim.add_argument("--scenario", type=str, default="control",
+                       dest="simScenario", metavar="NAME|PATH",
+                       help="built-in scenario name (control, diurnal, "
+                            "burst, preemption_wave, chaos) or a "
+                            "scenario JSON path (default control)")
+    p_sim.add_argument("--seed", type=int, default=0, dest="simSeed",
+                       metavar="N",
+                       help="simulation seed — same seed + same "
+                            "scenario = byte-identical event log "
+                            "(default 0)")
+    p_sim.add_argument("--replicas", type=int, default=0,
+                       dest="simReplicas", metavar="N",
+                       help="fleet size override (0 = scenario default)")
+    p_sim.add_argument("--duration", type=float, default=0.0,
+                       dest="simDuration", metavar="S",
+                       help="virtual-seconds override (0 = scenario "
+                            "default)")
+    p_sim.add_argument("--model", type=str, default=None,
+                       dest="simModel", metavar="PATH",
+                       help="latency-model JSON from "
+                            "scripts/extract_latency_model.py (default: "
+                            "built-in calibration)")
+
     # Static analysis (analysis/ graftlint) — no JAX backend touched.
     p_lint = sub.add_parser(
         "lint", help="run the graftlint static analysis pass "
@@ -1115,6 +1168,13 @@ def config_from_argv(argv=None) -> Config:
                       fd_canary_p95_factor=args.fdCanaryP95Factor)
     if args.action == "incidents":
         return Config(action="incidents", rsl_path=args.rsl_path)
+    if args.action == "sim":
+        return Config(action="sim", rsl_path=args.rsl_path,
+                      sim_scenario=args.simScenario,
+                      sim_seed=args.simSeed,
+                      sim_replicas=args.simReplicas,
+                      sim_duration=args.simDuration,
+                      sim_model=args.simModel)
     if args.action == "lint":
         return Config(action="lint", lint_json=args.json,
                       lint_paths=tuple(args.paths),
@@ -1156,6 +1216,7 @@ def config_from_argv(argv=None) -> Config:
         elastic_target=args.elasticTarget,
         elastic_min_world=args.elasticMinWorld,
         elastic_join=args.elasticJoin,
+        elastic_join_wait=args.elasticJoinWait,
         keep_ckpts=args.keepCkpts,
         compilation_cache_dir=args.compilationCacheDir,
         no_compile_cache=args.noCompileCache,

@@ -157,6 +157,8 @@ QUIESCE_BARRIER_S = 60.0
 # How long a joiner waits for an admit/decline marker after dropping
 # its claim.  Survivors only scan claims at health boundaries (epoch
 # ends), so this must dominate an epoch plus a reconfigure window.
+# Default only — `--elastic-join-wait` overrides it per run (short
+# epochs don't need 10 minutes; simulator scenarios need seconds).
 JOIN_WAIT_S = 600.0
 
 
@@ -680,6 +682,11 @@ def wait_for_admission(elastic_dir: str, jid: str,
                     f"{doc.get('reason', 'unspecified')}")
             return doc
         time.sleep(0.2)
+    # Signal before raising: a joiner that gives up is a capacity event
+    # the fleet operator needs in the JSONL, not just a stack trace on a
+    # host that's about to be recycled.
+    telemetry.get().event("elastic/join_wait_timeout", jid=jid,
+                          wait_s=wait_s, elastic_dir=elastic_dir)
     raise TimeoutError(
         f"elastic join {jid}: no admit/decline marker within "
         f"{wait_s:.0f}s — is an --elastic run reaching health "

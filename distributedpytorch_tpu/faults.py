@@ -101,7 +101,11 @@ KINDS = ("ioerror", "fatal", "preempt", "torn", "stall", "rank_loss",
 SITES = ("data.read", "data.host_batch", "ckpt.save", "ckpt.finalize",
          "ckpt.restore", "runtime.init", "elastic.reinit",
          "elastic.join", "elastic.grow_reinit", "telemetry.write",
-         "serve.request", "serve.infer", "serve.admit")
+         "serve.request", "serve.infer", "serve.admit", "sim.step")
+# "sim.step" is consumed by the fleet simulator (sim/scenario.py), which
+# reuses this plan DSL with a time-based reading: after_n = virtual
+# seconds, count = replicas (rank_loss/preempt/rank_join) or requests
+# (ioerror) affected.  fire() never targets it in a live process.
 
 # Exit code of a rank killed by kind=rank_loss: distinguishable in the
 # harness from a crash (1), a fatal-agreement exit (CHILD_EXIT) and a

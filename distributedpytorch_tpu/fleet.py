@@ -196,6 +196,78 @@ def _hist_state(h: telemetry.Histogram) -> Dict[str, Any]:
             "buckets": dict(h._buckets)}
 
 
+# -- sample / incident schema factories --------------------------------
+#
+# The fleet-metrics.jsonl sample and the incident bundle are CONTRACTS
+# shared by the live collector below and the fleet simulator
+# (sim/artifacts.py), which synthesizes the same shapes from a virtual
+# clock — `main.py fleet`/`incidents` and slo.evaluate consume both
+# streams identically because both go through these builders.
+
+def build_fleet_sample(*, ts: float, mono: float, cycle: int,
+                       alive: List[int], merged: Dict[str, Any],
+                       targets: Dict[str, Dict[str, Any]]
+                       ) -> Dict[str, Any]:
+    """One scrape-cycle sample (sans verdicts, which the caller appends
+    after slo.evaluate).  Clock contract: ts is a stamp (never
+    subtracted); mono is the ordering time and the SLO evaluator's
+    pure ``t``."""
+    return {
+        "kind": "fleet_sample", "ts": ts, "mono": mono,
+        "t": mono, "cycle": int(cycle),
+        "alive": list(alive),
+        "counters": merged["counters"],
+        "gauges": merged["gauges"],
+        "histograms": {n: _hist_state(h)
+                       for n, h in merged["histograms"].items()},
+        "targets": targets,
+    }
+
+
+def encode_sample(sample: Dict[str, Any]) -> str:
+    """Canonical JSONL serialization of one sample (sorted keys) —
+    byte-stable, which is what makes same-seed simulator runs
+    byte-identical."""
+    return json.dumps(sample, sort_keys=True, default=float)
+
+
+def build_incident(*, name: str, spec: Dict[str, Any],
+                   verdict: Dict[str, Any], cycle: int, ts: float,
+                   alive: List[int], suspect_ranks: List[int],
+                   offending_requests: List[str],
+                   healthz: Dict[str, Any]) -> Dict[str, Any]:
+    """One incident bundle document."""
+    return {
+        "kind": "incident", "slo": name,
+        "slo_kind": spec["kind"], "spec": spec,
+        "cycle": int(cycle), "ts": ts,
+        "windows": verdict["windows"],
+        "alive": list(alive),
+        "suspect_ranks": list(suspect_ranks),
+        "offending_requests": list(offending_requests),
+        "healthz": healthz,
+    }
+
+
+def incident_filename(seq: int, name: str) -> str:
+    return "incident-%03d-%s.json" % (int(seq), name)
+
+
+def write_incident_bundle(rsl_path: str, seq: int, name: str,
+                          bundle: Dict[str, Any]) -> Optional[str]:
+    """Persist one bundle; returns the path or None on an unwritable
+    disk (observability never takes the control plane down)."""
+    path = os.path.join(rsl_path, incident_filename(seq, name))
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(bundle, f, sort_keys=True, default=float, indent=1)
+    except OSError as e:
+        logging.error(f"fleet: cannot write incident bundle "
+                      f"{path!r}: {e}")
+        return None
+    return path
+
+
 def render_fleet_metrics(merged: Dict[str, Any], alive: int) -> str:
     """The merged series as Prometheus text — same exposition shape as
     the per-rank exporter, with ``dpt_up`` = the alive-rank count."""
@@ -340,23 +412,14 @@ class FleetCollector:
         alive = [t for t in self._targets if t.alive]
         merged = merge_targets([t.parsed for t in alive
                                 if t.parsed is not None])
-        mono = time.monotonic()
-        sample: Dict[str, Any] = {
-            # clock contract: ts is a stamp (never subtracted); mono is
-            # the ordering time and the SLO evaluator's pure "t".
-            "kind": "fleet_sample", "ts": time.time(), "mono": mono,
-            "t": mono, "cycle": self.cycle,
-            "alive": [t.rank for t in alive],
-            "counters": merged["counters"],
-            "gauges": merged["gauges"],
-            "histograms": {n: _hist_state(h)
-                           for n, h in merged["histograms"].items()},
-            "targets": {str(t.rank): {
+        sample = build_fleet_sample(
+            ts=time.time(), mono=time.monotonic(), cycle=self.cycle,
+            alive=[t.rank for t in alive], merged=merged,
+            targets={str(t.rank): {
                 "port": t.port,
                 "counters": (t.parsed or {}).get("counters", {}),
                 "health": t.health,
-            } for t in alive},
-        }
+            } for t in alive})
         self._samples.append(sample)
         verdicts = (slo.evaluate(self.slos, list(self._samples))
                     if self.slos else [])
@@ -429,27 +492,16 @@ class FleetCollector:
                         sample: Dict[str, Any]) -> None:
         spec = next(s for s in self.slos if s["name"] == name)
         self.incidents_written += 1
-        bundle = {
-            "kind": "incident", "slo": name,
-            "slo_kind": spec["kind"], "spec": spec,
-            "cycle": self.cycle, "ts": sample["ts"],
-            "windows": verdict["windows"],
-            "alive": sample["alive"],
-            "suspect_ranks": self._suspects(spec, verdict),
-            "offending_requests": self._offenders(sample, verdict),
-            "healthz": {rank: doc.get("health")
-                        for rank, doc in sample["targets"].items()},
-        }
-        path = os.path.join(
-            self.rsl_path,
-            "incident-%03d-%s.json" % (self.incidents_written, name))
-        try:
-            with open(path, "w", encoding="utf-8") as f:
-                json.dump(bundle, f, sort_keys=True, default=float,
-                          indent=1)
-        except OSError as e:
-            logging.error(f"fleet: cannot write incident bundle "
-                          f"{path!r}: {e}")
+        bundle = build_incident(
+            name=name, spec=spec, verdict=verdict, cycle=self.cycle,
+            ts=sample["ts"], alive=sample["alive"],
+            suspect_ranks=self._suspects(spec, verdict),
+            offending_requests=self._offenders(sample, verdict),
+            healthz={rank: doc.get("health")
+                     for rank, doc in sample["targets"].items()})
+        path = write_incident_bundle(self.rsl_path,
+                                     self.incidents_written, name, bundle)
+        if path is None:
             return
         logging.warning(
             f"fleet: INCIDENT — slo {name!r} firing at cycle "
@@ -466,8 +518,7 @@ class FleetCollector:
                 self._sink = open(
                     os.path.join(self.rsl_path, "fleet-metrics.jsonl"),
                     "a", encoding="utf-8")
-            self._sink.write(json.dumps(sample, sort_keys=True,
-                                        default=float) + "\n")
+            self._sink.write(encode_sample(sample) + "\n")
             self._sink.flush()
         except OSError as e:
             logging.error(f"fleet: cannot persist fleet-metrics.jsonl "

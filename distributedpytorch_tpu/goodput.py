@@ -79,6 +79,74 @@ CATEGORIES = (
 RESIDUAL = "other"
 
 
+# -- ledger schema factories ------------------------------------------
+#
+# The persisted document shape is a CONTRACT shared by the live ledger
+# below and the fleet simulator (sim/artifacts.py), which writes the
+# same schema from a virtual clock.  Both go through these builders so
+# `main.py goodput` / the timeline category track render simulated
+# fleets unchanged.
+
+def build_epoch_row(*, epoch: Optional[int], wall_s: float, mono: float,
+                    ts: float, residual_s: float,
+                    categories: Dict[str, float]) -> Dict[str, Any]:
+    """One reconcile-window row of the ledger's ``epochs`` list; the
+    rounding rules live here, once."""
+    return {
+        "epoch": epoch,
+        "wall_s": round(wall_s, 6),
+        "mono": mono,               # END stamp for timeline
+        "ts": ts,                   # stamp only, for humans
+        "residual_s": round(residual_s, 6),
+        "residual_frac": (round(residual_s / wall_s, 6)
+                          if wall_s > 0 else 0.0),
+        "categories": {c: round(v, 6) for c, v in categories.items()},
+    }
+
+
+def build_ledger_doc(*, rank: int, world: int, started_ts: float,
+                     wall_s: float, totals: Dict[str, float],
+                     epochs: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The persisted ledger document (also what /metrics reads live)."""
+    accounted = sum(totals.values())
+    return {
+        "version": 1,
+        "rank": int(rank),
+        "world": int(world),
+        "started_ts": started_ts,
+        "wall_s": round(wall_s, 6),
+        "accounted_s": round(accounted, 6),
+        "residual_frac": (round((wall_s - accounted) / wall_s, 6)
+                          if wall_s > 0 else 0.0),
+        "categories": {c: round(v, 6) for c, v in totals.items()},
+        "epochs": list(epochs),
+    }
+
+
+def ledger_filename(rank: int) -> str:
+    """Rank 0 owns the canonical ``goodput.json``; other ranks write
+    rank-suffixed files (no shared-file write races)."""
+    return ("goodput.json" if rank == 0
+            else "goodput-rank%d.json" % rank)
+
+
+def write_ledger_doc(rsl_path: str, doc: Dict[str, Any]) -> Optional[str]:
+    """Atomically persist one ledger document under ``rsl_path``;
+    returns the path, or None on an unwritable disk (never raises —
+    the ledger is observability, not training state)."""
+    path = os.path.join(rsl_path, ledger_filename(int(doc.get("rank", 0))))
+    tmp = path + ".tmp"
+    try:
+        os.makedirs(rsl_path, exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError as e:  # pragma: no cover - disk-full etc.
+        logging.warning("goodput: write failed (%s) — ledger lost", e)
+        return None
+    return path
+
+
 class GoodputLedger:
     """Per-process wall-clock attribution ledger.
 
@@ -198,15 +266,9 @@ class GoodputLedger:
         # (float rounding across thousands of adds) at zero.
         self._totals[RESIDUAL] += max(0.0, residual)
         deltas[RESIDUAL] += max(0.0, residual)
-        row = {
-            "epoch": epoch,
-            "wall_s": round(window, 6),
-            "mono": time.monotonic(),          # END stamp for timeline
-            "ts": time.time(),                 # stamp only, for humans
-            "residual_s": round(residual, 6),
-            "residual_frac": round(residual / window, 6) if window > 0 else 0.0,
-            "categories": {c: round(v, 6) for c, v in deltas.items()},
-        }
+        row = build_epoch_row(epoch=epoch, wall_s=window,
+                              mono=time.monotonic(), ts=time.time(),
+                              residual_s=residual, categories=deltas)
         self._epochs.append(row)
         self._mark_wall = wall
         self._mark_totals = dict(self._totals)
@@ -216,38 +278,17 @@ class GoodputLedger:
     def snapshot(self) -> Dict[str, Any]:
         """The persisted document (also what /metrics reads live)."""
         wall = time.perf_counter() - self._t0
-        accounted = sum(self._totals.values())
-        return {
-            "version": 1,
-            "rank": self.rank,
-            "world": self.world,
-            "started_ts": self._started_ts,
-            "wall_s": round(wall, 6),
-            "accounted_s": round(accounted, 6),
-            "residual_frac": round((wall - accounted) / wall, 6) if wall > 0 else 0.0,
-            "categories": {c: round(v, 6) for c, v in self._totals.items()},
-            "epochs": list(self._epochs),
-        }
+        return build_ledger_doc(rank=self.rank, world=self.world,
+                                started_ts=self._started_ts,
+                                wall_s=wall, totals=self._totals,
+                                epochs=self._epochs)
 
     def write(self) -> Optional[str]:
-        """Atomically persist the ledger under rsl_path.  Rank 0 owns
-        the canonical ``goodput.json``; other ranks write
-        ``goodput-rank<N>.json`` (no shared-file write races)."""
+        """Atomically persist the ledger under rsl_path (see
+        :func:`write_ledger_doc` for the filename convention)."""
         if not self.enabled or not self.rsl_path:
             return None
-        name = ("goodput.json" if self.rank == 0
-                else "goodput-rank%d.json" % self.rank)
-        path = os.path.join(self.rsl_path, name)
-        tmp = path + ".tmp"
-        try:
-            os.makedirs(self.rsl_path, exist_ok=True)
-            with open(tmp, "w") as f:
-                json.dump(self.snapshot(), f, indent=1, sort_keys=True)
-            os.replace(tmp, path)
-        except OSError as e:  # pragma: no cover - disk-full etc.
-            logging.warning("goodput: write failed (%s) — ledger lost", e)
-            return None
-        return path
+        return write_ledger_doc(self.rsl_path, self.snapshot())
 
     def close(self) -> None:
         """Final reconcile (tail window after the last epoch) + write +

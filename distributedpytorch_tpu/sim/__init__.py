@@ -1,0 +1,36 @@
+"""Deterministic fleet simulator (ISSUE 20 tentpole).
+
+A seeded discrete-event simulator that proves the control planes at
+N=100+ replicas — the scale where the interesting policy failures live
+(autoscale oscillation under diurnal traffic, shed cascades through the
+front door's pending budget, rejoin thrash after a preemption wave) and
+which no gloo subprocess harness on this container can afford.
+
+The design rule, and the reason every decider in this repo is a pure
+clock-free function of (config, sample window): the simulator composes
+the REAL policy code, never reimplementations.  What runs in here is
+
+  * ``serving/planner.py``      batch planning per simulated dispatch,
+  * ``serving/frontdoor.py``    admission / routing / health ejection,
+  * ``serving/controller.py``   the autoscale ladder over fleet samples,
+  * ``serving/rollout.py``      canary promote/rollback verdicts,
+  * ``elastic.py``              join admission (evaluate_join_policy),
+  * ``slo.py``                  burn-rate evaluation over the samples,
+  * ``faults.py``               the fault-plan DSL and RetryPolicy's
+                                deterministic backoff schedule,
+
+driven by a virtual clock: time exists only as the event heap's ``t``.
+No module in sim/ reads a wall clock or an unseeded RNG (graftlint rule
+21 ``nondeterminism-in-policy`` pins this), so the same seed and
+scenario produce a byte-identical event log — replayable, diffable,
+bisectable.
+
+Artifacts come out in the repo's live JSONL schemas (via the shared
+schema factories in telemetry/tracing/goodput/fleet), so ``main.py
+goodput``, ``timeline``, ``fleet`` and ``incidents`` render a simulated
+fleet unchanged.  Entry points: ``python main.py sim --scenario ...``
+and ``scripts/sim_gate.py`` (the robustness-floor gate).
+"""
+
+from .engine import BASE_TS, FleetSim  # noqa: F401
+from .runner import run_cli, run_scenario  # noqa: F401

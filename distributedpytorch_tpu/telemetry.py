@@ -75,6 +75,34 @@ from typing import Any, Dict, Iterable, List, Optional
 _FLUSH_EVERY = 1024  # buffered events before an automatic flush
 
 
+# -- record schema factories ------------------------------------------
+#
+# The JSONL line shape is a CONTRACT shared by the live emitter below
+# and the fleet simulator (sim/artifacts.py), which writes the same
+# schema with virtual clocks.  Both go through these two functions so
+# the schema cannot fork: a field added to the live stream is a field
+# the simulated stream gets for free, and vice versa.
+
+def stamp_record(payload: Dict[str, Any], *, ts: float, mono: float,
+                 rank: int) -> Dict[str, Any]:
+    """One telemetry record: the caller's payload plus the paired
+    ``ts``/``mono`` stamps and the emitting rank.  Pure — the clocks
+    are arguments, so the simulator stamps virtual time through the
+    exact code path the live emitter uses."""
+    out = dict(payload)
+    out["ts"] = ts
+    out["mono"] = mono
+    out["rank"] = rank
+    return out
+
+
+def encode_line(payload: Dict[str, Any]) -> str:
+    """The canonical JSONL serialization (sorted keys, floats for
+    anything exotic) — byte-stable for identical payloads, which is
+    what makes same-seed simulator runs byte-identical."""
+    return json.dumps(payload, sort_keys=True, default=float)
+
+
 class Counter:
     """Monotonic accumulator; summarized as one event at flush time."""
 
@@ -340,10 +368,9 @@ class Telemetry:
         if not self.enabled:
             return
         # Paired stamps — see the module-docstring timestamp contract.
-        payload["ts"] = time.time()
-        payload["mono"] = time.monotonic()
-        payload["rank"] = self.rank
-        line = json.dumps(payload, sort_keys=True, default=float)
+        line = encode_line(stamp_record(payload, ts=time.time(),
+                                        mono=time.monotonic(),
+                                        rank=self.rank))
         with self._lock:
             self._buffer.append(line)
             if len(self._buffer) >= _FLUSH_EVERY:

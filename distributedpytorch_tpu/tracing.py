@@ -70,6 +70,50 @@ BAD_OUTCOMES = ("failed", "shed", "timeout")
 _ID_RE = re.compile(r"^r(\d+)-(\d+)$")
 
 
+def request_id(rank: int, seq: int) -> str:
+    """The deterministic request id: ``r<rank>-<seq>`` (no randomness —
+    the id IS the rank + admission order)."""
+    return "r%d-%06d" % (rank, seq)
+
+
+def build_request_record(*, rank: int, seq: int, ts_admit: float,
+                         mono_admit: float, status: int, outcome: str,
+                         spans: Dict[str, float], ts: float, mono: float,
+                         bucket: Optional[int] = None,
+                         latency_ms: Optional[float] = None,
+                         attrs: Optional[Dict[str, Any]] = None,
+                         lineage: Optional[str] = None) -> Dict[str, Any]:
+    """One trace record, schema-factory form (shared with the fleet
+    simulator, which passes virtual clocks): the rounding rules and the
+    ``total_s == sum(spans)`` chain invariant live HERE, once, so the
+    simulated stream reconciles through :func:`reconcile` by the same
+    construction the live stream does."""
+    record: Dict[str, Any] = {
+        "kind": "request", "id": request_id(rank, seq), "seq": int(seq),
+        "rank": int(rank),
+        "ts_admit": ts_admit, "mono_admit": mono_admit,
+        "status": int(status), "outcome": outcome,
+        "spans": {k: round(float(v), 6) for k, v in spans.items()},
+        "total_s": round(sum(float(v) for v in spans.values()), 6),
+        "ts": ts, "mono": mono,
+    }
+    if bucket is not None:
+        record["bucket"] = int(bucket)
+    if latency_ms is not None:
+        record["latency_ms"] = round(float(latency_ms), 3)
+    if attrs:
+        record["attrs"] = attrs
+    if lineage is not None:
+        record["lineage"] = lineage
+    return record
+
+
+def encode_record(record: Dict[str, Any]) -> str:
+    """Canonical serialization (sorted keys) — the byte-stable form
+    both the live writer and the simulator append."""
+    return json.dumps(record, sort_keys=True, default=float)
+
+
 class RequestTrace:
     """One request's span chain.  The handler thread creates it and
     finishes it; the driver thread marks the dequeue/infer boundaries in
@@ -80,7 +124,7 @@ class RequestTrace:
                  "latency_ms", "_tracer", "_mark", "_finished")
 
     def __init__(self, tracer: "Tracer", seq: int):
-        self.id = "r%d-%06d" % (tracer.rank, seq)
+        self.id = request_id(tracer.rank, seq)
         self.seq = seq
         self.ts_admit = time.time()
         self.mono_admit = time.monotonic()
@@ -129,21 +173,8 @@ class RequestTrace:
         terminal = {"shed": "shed", "timeout": "timeout"}.get(outcome,
                                                               "respond")
         self._close(terminal)
-        record = {
-            "kind": "request", "id": self.id, "seq": self.seq,
-            "rank": self._tracer.rank,
-            "ts_admit": self.ts_admit, "mono_admit": self.mono_admit,
-            "status": int(status), "outcome": outcome,
-            "spans": {k: round(v, 6) for k, v in self.spans.items()},
-            "total_s": round(sum(self.spans.values()), 6),
-        }
-        if self.bucket is not None:
-            record["bucket"] = self.bucket
-        if self.latency_ms is not None:
-            record["latency_ms"] = self.latency_ms
-        if attrs:
-            record["attrs"] = attrs
-        self._tracer._write(self, record)
+        self._tracer._write(self, status=int(status), outcome=outcome,
+                            attrs=attrs or None)
 
 
 class Tracer:
@@ -179,13 +210,17 @@ class Tracer:
             self._seq += 1
             return RequestTrace(self, self._seq)
 
-    def _write(self, trace: RequestTrace, record: Dict[str, Any]) -> None:
+    def _write(self, trace: RequestTrace, *, status: int, outcome: str,
+               attrs: Optional[Dict[str, Any]]) -> None:
         # Paired stamps at terminal time — the clock contract's
         # stamp-only wall time plus the ordering clock.
-        record["ts"] = time.time()
-        record["mono"] = time.monotonic()
-        if self.lineage is not None:
-            record["lineage"] = self.lineage
+        record = build_request_record(
+            rank=self.rank, seq=trace.seq,
+            ts_admit=trace.ts_admit, mono_admit=trace.mono_admit,
+            status=status, outcome=outcome, spans=trace.spans,
+            ts=time.time(), mono=time.monotonic(),
+            bucket=trace.bucket, latency_ms=trace.latency_ms,
+            attrs=attrs, lineage=self.lineage)
         with self._lock:
             if trace._finished:
                 return  # the 504-then-late-complete race: first wins
@@ -197,9 +232,7 @@ class Tracer:
                     os.makedirs(os.path.dirname(self.path) or ".",
                                 exist_ok=True)
                     self._file = open(self.path, "a", encoding="utf-8")
-                self._file.write(
-                    json.dumps(record, sort_keys=True, default=float)
-                    + "\n")
+                self._file.write(encode_record(record) + "\n")
                 # Requests are orders of magnitude rarer than train
                 # steps: flush per record so gates and the fleet
                 # collector read complete records mid-run.

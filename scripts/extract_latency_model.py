@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Fit the simulator's latency model from real run artifacts.
+
+Reads flightrec dumps (``flightrec-rank*.json``) and, when present, the
+goodput ledger from a run directory, fits the quantile sketches
+sim/latency.py samples from, and writes a model JSON stamped with full
+provenance (input files, sha256s, record counts, the batch_rows
+assumption) — so every simulated result names its calibration source.
+
+Quantity mapping, stated once:
+
+  step_s          <- each step record's ``step_s`` (training realism),
+  infer_base_s    <- ``dispatch_s`` — the accelerator dispatch slice is
+                     the fixed cost of one simulated batch dispatch,
+  infer_per_row_s <- (step_s - dispatch_s) / batch_rows — the host-side
+                     per-step tail amortized over the rows of one batch
+                     (the marginal row cost the planner's padding pays).
+
+``respond_s`` is intentionally NOT fitted: flightrec doesn't observe a
+serving write-back, and inventing one here would be calibration
+theater.  The sampler falls back to the built-in default for any
+quantity a model file omits.
+
+Usage:
+  python scripts/extract_latency_model.py RUN_DIR [-o MODEL.json]
+                                          [--batch-rows N]
+"""
+
+import argparse
+import glob
+import hashlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from distributedpytorch_tpu.sim import latency as latmod  # noqa: E402
+
+
+def _quantiles(values):
+    """Empirical quantiles at the sketch's pinned points (sorted-array
+    interpolation — scipy-free on purpose)."""
+    vs = sorted(values)
+    out = {}
+    for key, q in latmod.QUANTILES:
+        pos = q * (len(vs) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(vs) - 1)
+        out[key] = round(vs[lo] + (vs[hi] - vs[lo]) * (pos - lo), 6)
+    return out
+
+
+def extract(run_dir, batch_rows=8):
+    """Returns (model_doc, n_steps).  ValueError when the directory has
+    nothing to fit from."""
+    paths = sorted(glob.glob(os.path.join(run_dir, "flightrec-rank*.json")))
+    if not paths:
+        raise ValueError(
+            f"no flightrec-rank*.json under {run_dir!r} — the model is "
+            f"fitted from flight-recorder step records")
+    if batch_rows < 1:
+        raise ValueError(f"--batch-rows must be >= 1 (got {batch_rows})")
+    step_s, base_s, per_row_s = [], [], []
+    inputs = []
+    for path in paths:
+        with open(path, "rb") as f:
+            blob = f.read()
+        doc = json.loads(blob)
+        n = 0
+        for rec in doc.get("records", []):
+            if rec.get("kind") != "step":
+                continue
+            s = rec.get("step_s")
+            if not isinstance(s, (int, float)) or s <= 0:
+                continue
+            n += 1
+            step_s.append(float(s))
+            d = rec.get("dispatch_s")
+            if isinstance(d, (int, float)) and 0 < d <= s:
+                base_s.append(float(d))
+                per_row_s.append((float(s) - float(d)) / batch_rows)
+        inputs.append({"path": os.path.basename(path),
+                       "sha256": hashlib.sha256(blob).hexdigest(),
+                       "step_records": n})
+    if not step_s:
+        raise ValueError(
+            f"flightrec dumps under {run_dir!r} hold no usable step "
+            f"records (need kind='step' with step_s > 0)")
+    quantities = {"step_s": _quantiles(step_s)}
+    if base_s:
+        quantities["infer_base_s"] = _quantiles(base_s)
+        quantities["infer_per_row_s"] = _quantiles(per_row_s)
+    provenance = {"source": "scripts/extract_latency_model.py",
+                  "run_dir": os.path.basename(os.path.abspath(run_dir)),
+                  "batch_rows": int(batch_rows), "inputs": inputs}
+    gp = os.path.join(run_dir, "goodput.json")
+    if os.path.exists(gp):
+        with open(gp, "rb") as f:
+            gblob = f.read()
+        ledger = json.loads(gblob)
+        provenance["goodput"] = {
+            "path": "goodput.json",
+            "sha256": hashlib.sha256(gblob).hexdigest(),
+            "wall_s": ledger.get("wall_s"),
+            "compute_frac": (
+                round(ledger["categories"].get("compute", 0.0)
+                      / ledger["wall_s"], 6)
+                if ledger.get("wall_s") else None)}
+    model = {"version": 1, "provenance": provenance,
+             "quantities": quantities}
+    latmod.validate_model(model, where="extracted model")
+    return model, len(step_s)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_dir", help="directory holding "
+                                    "flightrec-rank*.json (+ goodput.json)")
+    ap.add_argument("-o", "--out", default="latency-model.json")
+    ap.add_argument("--batch-rows", type=int, default=8,
+                    help="rows per batch when amortizing the per-step "
+                         "tail into a per-row cost (default 8)")
+    args = ap.parse_args(argv)
+    try:
+        model, n = extract(args.run_dir, batch_rows=args.batch_rows)
+    except ValueError as e:
+        print(f"extract_latency_model: {e}", file=sys.stderr)
+        return 1
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(model, f, indent=1, sort_keys=True)
+        f.write("\n")
+    qs = {name: q["p50"] for name, q in model["quantities"].items()}
+    print(f"extract_latency_model: fitted {len(model['quantities'])} "
+          f"quantities from {n} step records -> {args.out} "
+          f"(p50s: {qs})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

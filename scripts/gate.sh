@@ -86,7 +86,15 @@
 #      fleet already serving the ledger head draws zero rollbacks and
 #      zero scale events — see scripts/rollout_gate.py and README
 #      "Front door, autoscaling & rollout"
-#  16. the driver's own gate: __graft_entry__.dryrun_multichip(8)
+#  16. sim gate: the deterministic fleet simulator replaying the REAL
+#      control-plane policies at N=100 — control scenario (zero scale
+#      actions / incidents / drops, byte-identical same-seed replay)
+#      then chaos (stall wave + 30% preemption + ioerror burst +
+#      canary rollout) against the robustness floors, with the
+#      artifacts re-parsed by the live telemetry/tracing/goodput/
+#      timeline pipelines — see scripts/sim_gate.py and README
+#      "Fleet simulator"
+#  17. the driver's own gate: __graft_entry__.dryrun_multichip(8)
 #      (clean env, exactly as the driver runs it)
 #
 # Tier map:
@@ -108,8 +116,8 @@ python - /tmp/graftlint_gate.json <<'PY'
 import json, sys
 payload = json.load(open(sys.argv[1]))
 missing = {"collective-divergence", "lock-order-cycle",
-           "mesh-axis-propagation",
-           "outbound-call-without-timeout"} - set(payload["rules"])
+           "mesh-axis-propagation", "outbound-call-without-timeout",
+           "nondeterminism-in-policy"} - set(payload["rules"])
 assert not missing, f"whole-program rules inactive: {sorted(missing)}"
 assert payload["findings"] == [], payload["findings"]
 print(f"whole-program rules active ({len(payload['rules'])} total), "
@@ -179,6 +187,9 @@ env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/chaos_gate.py --stage fleet
 
 echo "== gate: rollout (canary rollback / kill+join repair / clean) =="
 env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/rollout_gate.py
+
+echo "== gate: sim (fleet simulator at N=100 / floors / replay) =="
+env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/sim_gate.py
 
 echo "== gate: dryrun_multichip(8) =="
 env -u XLA_FLAGS -u JAX_PLATFORMS python -c \

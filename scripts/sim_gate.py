@@ -1,0 +1,189 @@
+#!/usr/bin/env python3
+"""Fleet-simulator gate leg (scripts/gate.sh), pure CPU, no sockets.
+
+Proves the control planes survive faults at fleet scale — the ISSUE-20
+robustness contracts, each against the REAL policy code under the
+deterministic simulator:
+
+  0. calibration — fit the latency model from the committed fixture
+     (tests/fixtures/sim) with scripts/extract_latency_model.py; the
+     model provenance (input sha256s) must land in every report.
+  A. control, N=10 — the null hypothesis: flat light traffic on an
+     over-provisioned fleet produces ZERO scale actions, ZERO
+     incidents, zero sheds, zero drops.  Plus determinism: the same
+     seed replayed => byte-identical event log (sha256 equality).
+  B. chaos, N=100 — diurnal ramp + a 6-replica stall wave + a
+     30%-of-fleet preemption wave + a 300-request ioerror burst + a
+     canary rollout, all at once.  Floors: zero dropped-forever
+     requests, <= 2 autoscale direction changes, every preempted slot
+     rejoins exactly once (no rejoin thrash), the world recovers to
+     >= min_world, the rollout promotes, and the incident list is
+     EXACTLY the one the fault plan designs (the ioerror burst's
+     availability breach — the stall and the wave must ride through).
+  C. artifact fidelity — the chaos artifacts parse through the LIVE
+     pipelines: telemetry.aggregate with zero skipped records,
+     tracing.reconcile with zero torn chains / violations on >= 1000
+     records, goodput.report, timeline.build_timeline, and the
+     incident bundles through slo.incidents_report.
+
+Run as ``env -u XLA_FLAGS JAX_PLATFORMS=cpu python scripts/sim_gate.py``.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from distributedpytorch_tpu.sim import runner as sim_runner  # noqa: E402
+from extract_latency_model import extract  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "sim")
+
+_checks = []
+
+
+def check(name, ok, detail=""):
+    _checks.append((name, bool(ok)))
+    print(f"  {'PASS' if ok else 'FAIL'}  {name}" +
+          (f"  ({detail})" if detail else ""))
+
+
+def assert_floors(report, floors):
+    """Every floor the scenario declares, asserted against the report.
+    Exact keys are exact; max_*/min-style keys are bounds."""
+    name = report["scenario"]
+    for key, want in sorted(floors.items()):
+        if key == "scale_actions":
+            got = report["scale"]["actions"]
+            check(f"{name}: scale_actions == {want}", got == want,
+                  f"got {got}")
+        elif key == "incidents_exact":
+            got = report["incidents"]
+            if isinstance(want, int):
+                check(f"{name}: incident count == {want}",
+                      len(got) == want, f"got {got}")
+            else:
+                check(f"{name}: incidents == {want}", got == list(want),
+                      f"got {got}")
+        elif key == "dropped_forever":
+            got = report["requests"]["dropped_forever"]
+            check(f"{name}: dropped_forever == {want}", got == want,
+                  f"got {got}")
+        elif key == "max_direction_changes":
+            got = report["scale"]["direction_changes"]
+            check(f"{name}: direction_changes <= {want}", got <= want,
+                  f"got {got}")
+        elif key == "max_shed_window_s":
+            got = report["shed_window_s"]
+            check(f"{name}: shed_window_s <= {want}", got <= want,
+                  f"got {got}")
+        elif key == "max_rejoin_admits_per_replica":
+            got = report["elastic"]["max_rejoin_admits_per_replica"]
+            check(f"{name}: rejoin admits/replica <= {want}",
+                  got <= want, f"got {got}")
+        elif key == "recover_world_min":
+            got = report["replicas_end"]
+            check(f"{name}: world recovered >= {want}", got >= want,
+                  f"got {got}")
+        elif key == "rollout_outcome":
+            got = report["rollout_outcome"]
+            check(f"{name}: rollout {want}", got == want, f"got {got}")
+        else:
+            check(f"{name}: floor key {key!r} known", False)
+
+
+def main():
+    t0 = time.perf_counter()
+    tmp = tempfile.mkdtemp(prefix="sim_gate_")
+
+    # -- 0. calibration from the committed fixture --------------------
+    print("== 0: calibrate from committed fixture")
+    model, n_steps = extract(FIXTURES, batch_rows=8)
+    model_path = os.path.join(tmp, "latency-model.json")
+    with open(model_path, "w", encoding="utf-8") as f:
+        json.dump(model, f, indent=1, sort_keys=True)
+    check("fixture yields step records", n_steps >= 32,
+          f"{n_steps} records")
+    check("model has fitted quantities",
+          set(model["quantities"]) >= {"step_s", "infer_base_s",
+                                       "infer_per_row_s"})
+    check("provenance pins input sha256s",
+          all(i.get("sha256") for i in model["provenance"]["inputs"]))
+
+    # -- A. control + determinism -------------------------------------
+    print("== A: control scenario (null hypothesis + determinism)")
+    ctl_dir = os.path.join(tmp, "control")
+    ctl = sim_runner.run_scenario("control", seed=7,
+                                  model_path=model_path,
+                                  rsl_path=ctl_dir)
+    from distributedpytorch_tpu.sim import scenario as scmod
+    assert_floors(ctl, scmod.SCENARIOS["control"]["floors"])
+    check("control: provenance flows into report",
+          ctl["latency_model_provenance"]["source"]
+          == "scripts/extract_latency_model.py")
+    ctl2 = sim_runner.run_scenario("control", seed=7,
+                                   model_path=model_path)
+    check("control: same seed => byte-identical event log",
+          ctl["event_log_sha256"] == ctl2["event_log_sha256"],
+          ctl["event_log_sha256"][:12])
+    ctl3 = sim_runner.run_scenario("control", seed=8,
+                                   model_path=model_path)
+    check("control: different seed => different log",
+          ctl["event_log_sha256"] != ctl3["event_log_sha256"])
+
+    # -- B. chaos at N=100 --------------------------------------------
+    print("== B: chaos scenario (N=100, stall + wave + ioerror + canary)")
+    chaos_dir = os.path.join(tmp, "chaos")
+    chaos = sim_runner.run_scenario("chaos", seed=7,
+                                    model_path=model_path,
+                                    rsl_path=chaos_dir)
+    assert_floors(chaos, scmod.SCENARIOS["chaos"]["floors"])
+    r = chaos["requests"]
+    check("chaos: fleet answered under fire",
+          r["answered"] >= 0.9 * r["admitted"],
+          f"{r['answered']}/{r['admitted']}")
+    check("chaos: the wave actually happened",
+          chaos["elastic"]["rejoin_admits"] == 30,
+          f"{chaos['elastic']['rejoin_admits']} rejoins")
+    check("chaos: ioerror burst fully consumed",
+          r["failed"] == 300, f"{r['failed']} failed")
+
+    # -- C. artifact fidelity through the LIVE pipelines --------------
+    print("== C: chaos artifacts through the live pipelines")
+    from distributedpytorch_tpu import (goodput, slo, telemetry,
+                                        timeline, tracing)
+    events = telemetry.load_events(os.path.join(chaos_dir, "telemetry"))
+    agg = telemetry.aggregate(events)
+    check("telemetry.aggregate: zero skipped",
+          agg.get("skipped_events", 0) == 0,
+          f"{len(events)} records, {len(agg['ranks'])} ranks")
+    records = tracing.load_records(chaos_dir)
+    problems = tracing.reconcile(records)
+    check("tracing.reconcile: >= 1000 records", len(records) >= 1000,
+          f"{len(records)}")
+    check("tracing.reconcile: zero torn/violating records",
+          not problems, problems[0] if problems else "")
+    check("goodput.report renders",
+          "wall-clock attribution" in goodput.report(chaos_dir))
+    tl = timeline.build_timeline(chaos_dir)
+    check("timeline builds over 100+ ranks",
+          len(tl["ranks"]) >= 100, f"{len(tl['ranks'])} ranks")
+    check("incidents_report names the designed incident",
+          "availability" in slo.incidents_report(chaos_dir))
+
+    failed = [n for n, ok in _checks if not ok]
+    print(f"sim_gate: {len(_checks) - len(failed)}/{len(_checks)} "
+          f"checks passed in {time.perf_counter() - t0:.1f}s")
+    if failed:
+        print("sim_gate: FAILED: " + "; ".join(failed))
+        return 1
+    print("sim_gate: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

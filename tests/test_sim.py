@@ -1,0 +1,205 @@
+"""Deterministic fleet simulator (sim/, ISSUE 20): schema compat with
+the live pipelines, byte-identical same-seed replay, the autoscaler's
+oscillation bound in closed loop, and the joiner give-up telemetry.
+
+The heavy fleet-scale proofs (N=100 chaos floors, the exact-incident
+pin) live in ``scripts/sim_gate.py``; these tests pin the CONTRACTS a
+refactor is most likely to tear: the simulator's artifacts must parse
+through telemetry.aggregate / tracing.reconcile / goodput.report /
+timeline.build_timeline with zero skips, and replaying a seed must
+reproduce the event log byte for byte.  Everything runs the control
+scenario at reduced duration — pure CPU, virtual clock, a few seconds.
+"""
+
+import json
+import math
+import os
+
+import pytest
+
+from distributedpytorch_tpu import (elastic, goodput, telemetry,
+                                    timeline, tracing)
+from distributedpytorch_tpu.config import config_from_argv
+from distributedpytorch_tpu.serving.controller import (QUEUE_GAUGE,
+                                                       decide_scale)
+from distributedpytorch_tpu.sim import runner as sim_runner
+from distributedpytorch_tpu.sim import scenario as scmod
+
+
+@pytest.fixture
+def restore_global():
+    yield
+    telemetry._active = telemetry.Telemetry(enabled=False)
+
+
+# -- determinism -------------------------------------------------------
+
+def test_same_seed_replays_byte_identical():
+    """The tentpole contract: seed in, event log out — twice.  The
+    sha256 is computed over the full canonical event JSONL, so any
+    nondeterminism anywhere in the loop (set iteration, unseeded rng,
+    wall-clock leakage) tears this."""
+    a = sim_runner.run_scenario("control", seed=11, duration_s=45.0)
+    b = sim_runner.run_scenario("control", seed=11, duration_s=45.0)
+    assert a["event_log_sha256"] == b["event_log_sha256"]
+    assert a["requests"] == b["requests"]
+    c = sim_runner.run_scenario("control", seed=12, duration_s=45.0)
+    assert a["event_log_sha256"] != c["event_log_sha256"]
+
+
+def test_control_is_the_null_hypothesis():
+    """Over-provisioned + flat light traffic: nothing moves."""
+    r = sim_runner.run_scenario("control", seed=3, duration_s=45.0)
+    assert r["scale"]["actions"] == 0
+    assert r["incidents"] == []
+    assert r["requests"]["dropped_forever"] == 0
+    assert r["requests"]["fd_shed"] == 0
+    assert r["requests"]["answered"] + r["in_flight_at_end"] \
+        == r["requests"]["admitted"]
+
+
+def test_unknown_scenario_raises():
+    with pytest.raises(ValueError, match="unknown scenario"):
+        sim_runner.run_scenario("nope", seed=0)
+
+
+def test_scenario_file_and_size_overrides(tmp_path):
+    path = tmp_path / "tiny.json"
+    path.write_text(json.dumps({"replicas": 3, "duration_s": 20.0,
+                                "traffic": {"kind": "constant",
+                                            "rps": 2.0}}))
+    r = sim_runner.run_scenario(str(path), seed=1)
+    assert r["scenario"] == "tiny" and r["replicas_start"] == 3
+    r2 = sim_runner.run_scenario("control", seed=1, replicas=4,
+                                 duration_s=20.0)
+    assert r2["replicas_start"] == 4
+
+
+# -- artifact schema compat with the live pipelines --------------------
+
+@pytest.fixture(scope="module")
+def control_run(tmp_path_factory):
+    rsl = str(tmp_path_factory.mktemp("simrun"))
+    report = sim_runner.run_scenario("control", seed=5, duration_s=60.0,
+                                     rsl_path=rsl)
+    return rsl, report
+
+
+def test_sim_telemetry_aggregates_with_zero_skips(control_run):
+    rsl, report = control_run
+    events = telemetry.load_events(os.path.join(rsl, "telemetry"))
+    agg = telemetry.aggregate(events)
+    assert agg["skipped_events"] == 0
+    assert len(agg["ranks"]) >= report["replicas_start"]
+    names = {e.get("name") for e in agg["events"]}
+    assert {"sim/replica_start", "sim/frontdoor_start"} <= names
+
+
+def test_sim_traces_reconcile_clean(control_run):
+    rsl, report = control_run
+    records = tracing.load_records(rsl)
+    assert len(records) == report["trace_records"] > 0
+    assert tracing.reconcile(records) == []
+
+
+def test_sim_goodput_and_timeline_render(control_run):
+    rsl, report = control_run
+    assert "wall-clock attribution" in goodput.report(rsl)
+    tl = timeline.build_timeline(rsl)
+    assert len(tl["ranks"]) >= report["replicas_start"]
+
+
+def test_sim_report_pins_model_provenance(control_run):
+    _, report = control_run
+    assert report["latency_model_provenance"]["source"]
+    assert report["event_log_sha256"]
+
+
+# -- autoscaler oscillation bound in closed loop -----------------------
+
+def _sample(t, world, depth):
+    return {"t": float(t), "alive": list(range(world)),
+            "gauges": {QUEUE_GAUGE: float(depth)}, "counters": {}}
+
+
+def test_decide_scale_diurnal_closed_loop_never_reverses():
+    """Property pin for the sim's autoscale floors: drive decide_scale
+    in closed loop (decisions change the world, the world changes the
+    queue depth) under five full diurnal periods.  The controller may
+    GROW to the settling size, but once settled the hysteresis must
+    hold — zero direction changes, world stable over the tail."""
+    cfg = {"min_world": 4, "max_world": 10, "queue_high": 8.0,
+           "queue_low": 1.0, "up_hold_s": 2.0, "down_hold_s": 40.0,
+           "cooldown_s": 5.0}
+    world, state, samples, actions = 4, {}, [], []
+    worlds = []
+    for t in range(300):  # 5 x 60s periods, 1s scrape cadence
+        load = 30.0 + 15.0 * math.sin(2 * math.pi * t / 60.0)
+        samples.append(_sample(t, world, depth=load / world))
+        samples = samples[-90:]
+        d = decide_scale(cfg, state, samples)
+        if d["action"] != "none":
+            actions.append((t, d["action"]))
+            state["last_action_t"] = float(t)
+            world = d["target"]
+        worlds.append(world)
+    kinds = [a for _, a in actions]
+    changes = sum(1 for x, y in zip(kinds, kinds[1:]) if x != y)
+    assert changes == 0, f"flapped: {actions}"
+    assert kinds and set(kinds) == {"up"}  # it did settle by growing
+    assert all(t < 120 for t, _ in actions), f"late action: {actions}"
+    assert len(set(worlds[120:])) == 1  # stable over the last 3 periods
+
+
+# -- scenario catalog sanity ------------------------------------------
+
+def test_every_builtin_scenario_loads_and_validates():
+    for name in scmod.SCENARIOS:
+        sc = scmod.load_scenario(name)
+        assert sc["name"] == name
+        scmod.timed_faults(sc, seed=0)
+
+
+def test_fault_plan_rejects_live_sites_and_fatal_kinds(tmp_path):
+    bad_site = dict(scmod.SCENARIOS["control"], name="x",
+                    fault_plan="data.read:ioerror:1:1")
+    p = tmp_path / "x.json"
+    p.write_text(json.dumps(bad_site))
+    with pytest.raises(ValueError, match="sim.step"):
+        sim_runner.run_scenario(str(p), seed=0)
+    bad_kind = dict(scmod.SCENARIOS["control"], name="y",
+                    fault_plan="sim.step:fatal:1:1")
+    p2 = tmp_path / "y.json"
+    p2.write_text(json.dumps(bad_kind))
+    with pytest.raises(ValueError, match="no fleet-level reading"):
+        sim_runner.run_scenario(str(p2), seed=0)
+
+
+# -- satellite: the joiner's bounded wait ------------------------------
+
+def test_join_wait_flag_parses():
+    cfg = config_from_argv(["train", "-d", "/x",
+                            "--elastic-join-wait", "45"])
+    assert cfg.elastic_join_wait == 45.0
+    assert config_from_argv(["train", "-d", "/x"]) \
+        .elastic_join_wait == 600.0
+
+
+def test_join_wait_timeout_emits_telemetry_event(tmp_path,
+                                                 restore_global):
+    """A joiner that gives up is a capacity event: the TimeoutError
+    must be preceded by an elastic/join_wait_timeout JSONL event
+    naming the claim and the wait bound."""
+    tel_dir = tmp_path / "tel"
+    telemetry.configure(str(tel_dir), enabled=True, rank=0)
+    with pytest.raises(TimeoutError, match="no admit/decline"):
+        elastic.wait_for_admission(str(tmp_path / "elastic"), "h-9",
+                                   timeout_s=0.3)
+    telemetry.get().close()
+    events = telemetry.load_events(os.path.join(str(tel_dir),
+                                                "telemetry"))
+    hits = [e for e in events
+            if e.get("name") == "elastic/join_wait_timeout"]
+    assert len(hits) == 1
+    assert hits[0]["attrs"]["jid"] == "h-9"
+    assert hits[0]["attrs"]["wait_s"] == 0.3
